@@ -1,0 +1,33 @@
+"""gofr_tpu — a TPU-native service framework.
+
+GoFr's developer surface (``app = gofr_tpu.App(); app.post("/chat", h);
+app.run()`` — cf. reference pkg/gofr/factory.go:17, rest.go:9-31) with a
+JAX/XLA/Pallas execution backend for ML routes: models, continuous
+batching, paged KV caches, and mesh-sharded multi-chip serving.
+
+Subpackages
+-----------
+- ``config``/``logging``/``metrics``/``tracing`` — the kernel layers.
+- ``container``/``context`` — dependency-injection hub + handler context.
+- ``http`` — asyncio HTTP server, router, middleware, responder.
+- ``service`` — resilient inter-service HTTP clients.
+- ``pubsub``/``cron``/``migrations``/``websocket``/``cli`` — app runtimes.
+- ``ops``/``models``/``parallel``/``serving`` — the TPU compute stack.
+
+Heavy imports (jax & friends) are deferred: importing :mod:`gofr_tpu`
+alone pulls only the service-framework layers.
+"""
+
+from .version import FRAMEWORK as __version__  # noqa: F401
+
+# Populated as the corresponding layers land; entries must only name
+# modules that exist in the tree.
+_LAZY: dict[str, tuple[str, str]] = {}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module 'gofr_tpu' has no attribute {name!r}")

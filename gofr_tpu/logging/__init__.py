@@ -1,0 +1,21 @@
+from .logger import (
+    DEBUG,
+    ERROR,
+    FATAL,
+    INFO,
+    NOTICE,
+    WARN,
+    ContextLogger,
+    Level,
+    Logger,
+    MockLogger,
+    level_from_string,
+    new_file_logger,
+    new_logger,
+)
+
+__all__ = [
+    "DEBUG", "ERROR", "FATAL", "INFO", "NOTICE", "WARN",
+    "ContextLogger", "Level", "Logger", "MockLogger",
+    "level_from_string", "new_file_logger", "new_logger",
+]

@@ -1,0 +1,10 @@
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Manager,
+    MetricsError,
+    UpDownCounter,
+)
+
+__all__ = ["Counter", "Gauge", "Histogram", "Manager", "MetricsError", "UpDownCounter"]

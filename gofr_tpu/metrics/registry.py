@@ -1,0 +1,217 @@
+"""Metrics manager with Prometheus text exposition.
+
+Mirrors the reference's metrics surface (pkg/gofr/metrics/register.go:16-51):
+``new_counter / new_up_down_counter / new_histogram / new_gauge`` to
+register, and ``increment_counter / delta_up_down_counter /
+record_histogram / set_gauge`` to write — all label-aware, all
+thread-safe, all served in Prometheus text format on the dedicated
+metrics port (reference metrics/handler.go:13, metrics_server.go:14-49).
+
+The implementation is self-contained (no OTel SDK dependency): a typed
+store keyed by metric name -> labelset -> value, like the reference's
+``store.go:9-28``, rendered on scrape.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Iterable, Mapping
+
+DEFAULT_BUCKETS = (0.001, 0.003, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 0.75, 1, 2, 3, 5, 10, 30)
+
+
+class MetricsError(Exception):
+    pass
+
+
+def _labels_key(labels: Mapping[str, str]) -> tuple[tuple[str, str], ...]:
+    return tuple(sorted(labels.items()))
+
+
+def _fmt_labels(key: tuple[tuple[str, str], ...]) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(v)
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str) -> None:
+        self.name = name
+        self.description = description
+        self._values: dict[tuple[tuple[str, str], ...], float] = {}
+        self._lock = threading.Lock()
+
+    def _bump(self, delta: float, labels: Mapping[str, str]) -> None:
+        key = _labels_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + delta
+
+    def _set(self, value: float, labels: Mapping[str, str]) -> None:
+        key = _labels_key(labels)
+        with self._lock:
+            self._values[key] = value
+
+    def get(self, **labels: str) -> float:
+        return self._values.get(_labels_key(labels), 0.0)
+
+    def render(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.description}"
+        yield f"# TYPE {self.name} {self.kind}"
+        with self._lock:
+            items = list(self._values.items())
+        for key, value in items:
+            yield f"{self.name}{_fmt_labels(key)} {_fmt_value(value)}"
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+
+class UpDownCounter(_Metric):
+    kind = "gauge"  # prometheus has no updown type; exposed as gauge
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str,
+                 buckets: Iterable[float] = DEFAULT_BUCKETS) -> None:
+        super().__init__(name, description)
+        self.buckets = tuple(sorted(buckets))
+        # labelset -> (bucket_counts, sum, count)
+        self._hist: dict[tuple[tuple[str, str], ...], tuple[list[int], float, int]] = {}
+
+    def record(self, value: float, labels: Mapping[str, str]) -> None:
+        key = _labels_key(labels)
+        with self._lock:
+            counts, total, n = self._hist.get(key, ([0] * len(self.buckets), 0.0, 0))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._hist[key] = (counts, total + value, n + 1)
+
+    def get_count(self, **labels: str) -> int:
+        entry = self._hist.get(_labels_key(labels))
+        return entry[2] if entry else 0
+
+    def get_sum(self, **labels: str) -> float:
+        entry = self._hist.get(_labels_key(labels))
+        return entry[1] if entry else 0.0
+
+    def render(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.description}"
+        yield f"# TYPE {self.name} histogram"
+        with self._lock:
+            items = [(k, ([*c], s, n)) for k, (c, s, n) in self._hist.items()]
+        for key, (counts, total, n) in items:
+            for bucket, count in zip(self.buckets, counts):
+                bkey = key + (("le", _fmt_value(float(bucket))),)
+                yield f"{self.name}_bucket{_fmt_labels(tuple(sorted(bkey)))} {count}"
+            inf_key = key + (("le", "+Inf"),)
+            yield f"{self.name}_bucket{_fmt_labels(tuple(sorted(inf_key)))} {n}"
+            yield f"{self.name}_sum{_fmt_labels(key)} {_fmt_value(total)}"
+            yield f"{self.name}_count{_fmt_labels(key)} {n}"
+
+
+class Manager:
+    """Register-then-write metrics facade (reference register.go:16)."""
+
+    def __init__(self, logger=None) -> None:
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+        self._logger = logger
+
+    def _register(self, metric: _Metric) -> None:
+        with self._lock:
+            if metric.name in self._metrics:
+                raise MetricsError(f"metric {metric.name!r} already registered")
+            self._metrics[metric.name] = metric
+
+    # -- registration
+    def new_counter(self, name: str, description: str) -> Counter:
+        m = Counter(name, description)
+        self._register(m)
+        return m
+
+    def new_up_down_counter(self, name: str, description: str) -> UpDownCounter:
+        m = UpDownCounter(name, description)
+        self._register(m)
+        return m
+
+    def new_histogram(self, name: str, description: str,
+                      buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
+        m = Histogram(name, description, buckets)
+        self._register(m)
+        return m
+
+    def new_gauge(self, name: str, description: str) -> Gauge:
+        m = Gauge(name, description)
+        self._register(m)
+        return m
+
+    # -- writes (no-op with a warning on unknown names, like the reference)
+    def _lookup(self, name: str, kind: type) -> _Metric | None:
+        m = self._metrics.get(name)
+        if m is None or not isinstance(m, kind):
+            if self._logger is not None:
+                self._logger.error(f"metric {name!r} not registered as {kind.__name__}")
+            return None
+        return m
+
+    def increment_counter(self, name: str, **labels: str) -> None:
+        m = self._lookup(name, Counter)
+        if m is not None:
+            m._bump(1.0, labels)
+
+    def add_counter(self, name: str, value: float, **labels: str) -> None:
+        m = self._lookup(name, Counter)
+        if m is not None:
+            m._bump(value, labels)
+
+    def delta_up_down_counter(self, name: str, delta: float, **labels: str) -> None:
+        m = self._lookup(name, UpDownCounter)
+        if m is not None:
+            m._bump(delta, labels)
+
+    def record_histogram(self, name: str, value: float, **labels: str) -> None:
+        m = self._lookup(name, Histogram)
+        if m is not None:
+            m.record(value, labels)
+
+    def set_gauge(self, name: str, value: float, **labels: str) -> None:
+        m = self._lookup(name, Gauge)
+        if m is not None:
+            m._set(value, labels)
+
+    def get(self, name: str) -> _Metric | None:
+        return self._metrics.get(name)
+
+    # -- scrape
+    def render_prometheus(self) -> str:
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        lines: list[str] = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"

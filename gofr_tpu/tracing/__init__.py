@@ -1,0 +1,14 @@
+from .tracer import (
+    Span,
+    SpanExporter,
+    ConsoleExporter,
+    InMemoryExporter,
+    Tracer,
+    extract_traceparent,
+    format_traceparent,
+)
+
+__all__ = [
+    "Span", "SpanExporter", "ConsoleExporter", "InMemoryExporter", "Tracer",
+    "extract_traceparent", "format_traceparent",
+]

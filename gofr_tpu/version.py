@@ -1,0 +1,6 @@
+"""Framework version, mirroring the reference's version package.
+
+Reference: /root/reference/pkg/gofr/version/version.go:1-3
+"""
+
+FRAMEWORK = "0.1.0-dev"

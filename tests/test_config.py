@@ -1,0 +1,66 @@
+"""Config loader tests — precedence contract from reference godotenv.go:36-77."""
+
+from gofr_tpu.config import DictConfig, EnvConfig, load_env_file
+
+
+def test_parse_env_file(tmp_path):
+    f = tmp_path / ".env"
+    f.write_text(
+        "# comment\n"
+        "APP_NAME=myapp\n"
+        "export HTTP_PORT=8000\n"
+        'QUOTED="hello world"\n'
+        "SINGLE='x # not comment'\n"
+        "TRAILING=value # comment here\n"
+        "EMPTY=\n"
+        "noequals\n"
+    )
+    values = load_env_file(f)
+    assert values["APP_NAME"] == "myapp"
+    assert values["HTTP_PORT"] == "8000"
+    assert values["QUOTED"] == "hello world"
+    assert values["SINGLE"] == "x # not comment"
+    assert values["TRAILING"] == "value"
+    assert values["EMPTY"] == ""
+    assert "noequals" not in values
+
+
+def test_missing_file_is_empty(tmp_path):
+    assert load_env_file(tmp_path / "nope.env") == {}
+
+
+def test_precedence_os_env_wins(tmp_path):
+    configs = tmp_path / "configs"
+    configs.mkdir()
+    (configs / ".env").write_text("A=base\nB=base\nC=base\n")
+    (configs / ".staging.env").write_text("B=staging\nC=staging\n")
+    cfg = EnvConfig(configs, environ={"APP_ENV": "staging", "C": "osenv"})
+    assert cfg.get("A") == "base"
+    assert cfg.get("B") == "staging"  # overlay wins over base
+    assert cfg.get("C") == "osenv"    # OS env wins over everything
+    assert cfg.get("D") is None
+
+
+def test_app_env_from_file(tmp_path):
+    configs = tmp_path / "configs"
+    configs.mkdir()
+    (configs / ".env").write_text("APP_ENV=dev\nX=1\n")
+    (configs / ".dev.env").write_text("X=2\n")
+    cfg = EnvConfig(configs, environ={})
+    assert cfg.get("X") == "2"
+
+
+def test_get_or_default_and_typed():
+    cfg = DictConfig({"PORT": "9090", "RATIO": "0.5", "ON": "true", "BAD": "xyz"})
+    assert cfg.get_or_default("PORT", "8000") == "9090"
+    assert cfg.get_or_default("MISSING", "8000") == "8000"
+    assert cfg.get_int("PORT", 1) == 9090
+    assert cfg.get_int("BAD", 7) == 7
+    assert cfg.get_float("RATIO", 1.0) == 0.5
+    assert cfg.get_bool("ON") is True
+    assert cfg.get_bool("MISSING", default=True) is True
+
+
+def test_empty_value_falls_to_default():
+    cfg = DictConfig({"E": ""})
+    assert cfg.get_or_default("E", "d") == "d"

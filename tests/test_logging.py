@@ -1,0 +1,104 @@
+"""Logger tests — level gating, JSON shape, trace injection, live level change."""
+
+import io
+import json
+
+from gofr_tpu.logging import (
+    DEBUG, ERROR, INFO, WARN,
+    ContextLogger, Logger, MockLogger, level_from_string,
+)
+from gofr_tpu.logging.logger import reset_trace_context, set_trace_context
+
+
+def test_level_gating():
+    log = MockLogger(level=WARN)
+    log.debug("d")
+    log.info("i")
+    log.warn("w")
+    log.error("e")
+    levels = [l["level"] for l in log.lines]
+    assert levels == ["WARN", "ERROR"]
+
+
+def test_json_shape_and_fields():
+    log = MockLogger(level=DEBUG)
+    log.info("hello", component="http", port=8000)
+    rec = log.lines[0]
+    assert rec["message"] == "hello"
+    assert rec["component"] == "http"
+    assert rec["port"] == 8000
+    assert rec["time"].endswith("Z")
+
+
+def test_percent_formatting():
+    log = MockLogger()
+    log.info("listening on %s:%d", "0.0.0.0", 8000)
+    assert log.lines[0]["message"] == "listening on 0.0.0.0:8000"
+
+
+def test_trace_context_injection():
+    log = MockLogger()
+    token = set_trace_context("a" * 32, "b" * 16)
+    try:
+        log.info("traced")
+    finally:
+        reset_trace_context(token)
+    log.info("untraced")
+    assert log.lines[0]["trace_id"] == "a" * 32
+    assert log.lines[0]["span_id"] == "b" * 16
+    assert "trace_id" not in log.lines[1]
+
+
+def test_change_level_live_and_context_logger():
+    base = MockLogger(level=INFO)
+    ctx_log = ContextLogger(base)
+    ctx_log.debug("hidden")
+    base.change_level(DEBUG)
+    ctx_log.debug("shown")
+    assert [l["message"] for l in base.lines] == ["shown"]
+
+
+def test_level_from_string():
+    assert level_from_string("debug") == DEBUG
+    assert level_from_string("ERROR") == ERROR
+    assert level_from_string("bogus") == INFO
+
+
+def test_pretty_mode_renders_colored_line():
+    buf = io.StringIO()
+    log = Logger(level=INFO, out=buf, err=buf, pretty=True)
+    log.warn("careful")
+    text = buf.getvalue()
+    assert "WARN" in text and "careful" in text and "\x1b[" in text
+
+
+def test_structured_message_dict():
+    log = MockLogger()
+    log.info({"event": "boot", "ok": True})
+    assert log.lines[0]["message"] == {"event": "boot", "ok": True}
+
+
+def test_thread_safety_no_interleaving():
+    import threading
+    log = MockLogger()
+
+    def spam(i):
+        for _ in range(50):
+            log.info(f"msg-{i}")
+
+    threads = [threading.Thread(target=spam, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(log.lines) == 200
+    for rec in log.lines:
+        json.dumps(rec)  # every line is valid standalone JSON
+
+
+def test_fatal_exits():
+    import pytest
+    log = MockLogger()
+    with pytest.raises(SystemExit):
+        log.fatal("dead")
+    assert log.lines[0]["level"] == "FATAL"

@@ -1,0 +1,83 @@
+"""Metrics manager tests — registration, writes, Prometheus exposition."""
+
+import pytest
+
+from gofr_tpu.metrics import Manager, MetricsError
+
+
+def test_counter_flow():
+    m = Manager()
+    m.new_counter("app_requests", "total requests")
+    m.increment_counter("app_requests", path="/a", method="GET")
+    m.increment_counter("app_requests", path="/a", method="GET")
+    m.increment_counter("app_requests", path="/b", method="POST")
+    c = m.get("app_requests")
+    assert c.get(path="/a", method="GET") == 2
+    assert c.get(path="/b", method="POST") == 1
+
+
+def test_duplicate_registration_rejected():
+    m = Manager()
+    m.new_counter("x", "d")
+    with pytest.raises(MetricsError):
+        m.new_counter("x", "again")
+
+
+def test_up_down_and_gauge():
+    m = Manager()
+    m.new_up_down_counter("inflight", "in-flight requests")
+    m.delta_up_down_counter("inflight", +1)
+    m.delta_up_down_counter("inflight", +1)
+    m.delta_up_down_counter("inflight", -1)
+    assert m.get("inflight").get() == 1
+    m.new_gauge("temp", "temperature")
+    m.set_gauge("temp", 42.5, zone="a")
+    assert m.get("temp").get(zone="a") == 42.5
+
+
+def test_histogram_buckets_and_render():
+    m = Manager()
+    m.new_histogram("lat", "latency", buckets=[0.1, 1.0, 10.0])
+    for v in (0.05, 0.5, 5.0, 50.0):
+        m.record_histogram("lat", v, path="/x")
+    h = m.get("lat")
+    assert h.get_count(path="/x") == 4
+    assert h.get_sum(path="/x") == pytest.approx(55.55)
+    text = m.render_prometheus()
+    assert 'lat_bucket{le="0.1",path="/x"} 1' in text
+    assert 'lat_bucket{le="1",path="/x"} 2' in text
+    assert 'lat_bucket{le="10",path="/x"} 3' in text
+    assert 'lat_bucket{le="+Inf",path="/x"} 4' in text
+    assert 'lat_count{path="/x"} 4' in text
+
+
+def test_prometheus_text_format():
+    m = Manager()
+    m.new_counter("hits", "hit count")
+    m.increment_counter("hits", route='/a"b')
+    text = m.render_prometheus()
+    assert "# HELP hits hit count" in text
+    assert "# TYPE hits counter" in text
+    assert 'hits{route="/a\\"b"} 1' in text
+
+
+def test_unknown_metric_write_is_noop():
+    m = Manager()
+    m.increment_counter("ghost")  # must not raise
+    m.record_histogram("ghost", 1.0)
+    m.set_gauge("ghost", 1.0)
+
+
+def test_wrong_kind_write_is_noop():
+    m = Manager()
+    m.new_counter("c", "d")
+    m.set_gauge("c", 5.0)  # counter written as gauge -> rejected
+    assert m.get("c").get() == 0.0
+
+
+def test_unwritten_metric_renders_no_phantom_series():
+    m = Manager()
+    m.new_counter("quiet", "never written")
+    text = m.render_prometheus()
+    assert "# TYPE quiet counter" in text
+    assert "\nquiet 0" not in text

@@ -1,0 +1,106 @@
+"""Tracer tests — span lifecycle, propagation, sampling, log correlation."""
+
+from gofr_tpu.logging import MockLogger
+from gofr_tpu.tracing import (
+    InMemoryExporter, Tracer, extract_traceparent, format_traceparent,
+)
+
+
+def test_span_lifecycle_and_export():
+    exp = InMemoryExporter()
+    tracer = Tracer(exporter=exp)
+    with tracer.start_span("GET /x") as span:
+        span.set_attribute("http.status", 200)
+    assert len(exp.spans) == 1
+    s = exp.spans[0]
+    assert s.name == "GET /x"
+    assert s.end_time is not None
+    assert s.attributes["http.status"] == 200
+    assert len(s.trace_id) == 32 and len(s.span_id) == 16
+
+
+def test_child_span_shares_trace():
+    exp = InMemoryExporter()
+    tracer = Tracer(exporter=exp)
+    with tracer.start_span("parent") as parent:
+        with tracer.start_span("child") as child:
+            assert child.trace_id == parent.trace_id
+            assert child.parent_id == parent.span_id
+    assert [s.name for s in exp.spans] == ["child", "parent"]
+
+
+def test_traceparent_roundtrip():
+    header = format_traceparent("ab" * 16, "cd" * 8)
+    parsed = extract_traceparent(header)
+    assert parsed == ("ab" * 16, "cd" * 8)
+    assert extract_traceparent("garbage") is None
+    assert extract_traceparent(None) is None
+    assert extract_traceparent("00-" + "0" * 32 + "-" + "1" * 16 + "-01") is None
+
+
+def test_remote_parent_continues_trace():
+    exp = InMemoryExporter()
+    tracer = Tracer(exporter=exp)
+    header = format_traceparent("12" * 16, "34" * 8)
+    with tracer.start_span("srv", traceparent=header) as span:
+        assert span.trace_id == "12" * 16
+        assert span.parent_id == "34" * 8
+
+
+def test_sampling_zero_exports_nothing():
+    exp = InMemoryExporter()
+    tracer = Tracer(exporter=exp, ratio=0.0)
+    with tracer.start_span("dropped"):
+        pass
+    assert exp.spans == []
+
+
+def test_inject_headers():
+    tracer = Tracer(exporter=InMemoryExporter())
+    with tracer.start_span("client") as span:
+        headers = tracer.inject_headers({})
+        assert headers["traceparent"] == format_traceparent(span.trace_id, span.span_id)
+    assert tracer.inject_headers({}) == {}
+
+
+def test_span_correlates_logs():
+    tracer = Tracer(exporter=InMemoryExporter())
+    log = MockLogger()
+    with tracer.start_span("op") as span:
+        log.info("inside")
+    rec = log.lines[0]
+    assert rec["trace_id"] == span.trace_id
+    assert rec["span_id"] == span.span_id
+
+
+def test_error_status_on_exception():
+    exp = InMemoryExporter()
+    tracer = Tracer(exporter=exp)
+    try:
+        with tracer.start_span("boom"):
+            raise ValueError("bad")
+    except ValueError:
+        pass
+    assert exp.spans[0].status.startswith("ERROR")
+
+
+def test_upstream_sampled_flag_honored():
+    exp = InMemoryExporter()
+    tracer = Tracer(exporter=exp, ratio=0.0)  # local ratio would drop
+    with tracer.start_span("s", traceparent="00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"):
+        pass
+    assert len(exp.spans) == 1  # upstream said sampled -> we keep it
+    with tracer.start_span("t", traceparent="00-" + "ab" * 16 + "-" + "cd" * 8 + "-00"):
+        pass
+    assert len(exp.spans) == 1  # upstream said not sampled -> dropped
+
+
+def test_end_from_other_thread_still_exports():
+    import threading
+    exp = InMemoryExporter()
+    tracer = Tracer(exporter=exp)
+    span = tracer.start_span("cross-thread")
+    t = threading.Thread(target=span.end)
+    t.start()
+    t.join()
+    assert len(exp.spans) == 1
