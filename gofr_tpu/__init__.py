@@ -20,9 +20,14 @@ alone pulls only the service-framework layers.
 
 from .version import FRAMEWORK as __version__  # noqa: F401
 
-# Populated as the corresponding layers land; entries must only name
-# modules that exist in the tree.
-_LAZY: dict[str, tuple[str, str]] = {}
+_LAZY: dict[str, tuple[str, str]] = {
+    "App": ("gofr_tpu.app", "App"),
+    "new_app": ("gofr_tpu.app", "new_app"),
+    "new_cmd": ("gofr_tpu.app", "new_cmd"),
+    "Context": ("gofr_tpu.context", "Context"),
+    "Container": ("gofr_tpu.container.container", "Container"),
+    "MockContainer": ("gofr_tpu.container.mock", "MockContainer"),
+}
 
 
 def __getattr__(name: str):

@@ -1,0 +1,278 @@
+"""The App facade: routing verbs, lifecycle, servers, hooks.
+
+Mirrors reference pkg/gofr/gofr.go + factory.go + run.go: ``App()``
+wires config -> container -> tracer -> HTTP/metrics servers and the
+default routes (health/alive/favicon, factory.go:48-52); route verbs
+(rest.go:9-31); ``run()`` installs signal-driven graceful shutdown and
+starts every server concurrently (run.go:15-95, shutdown.go:14-48);
+``on_start`` hooks (gofr.go:54-88); ``subscribe`` (gofr.go:249);
+``add_cron_job`` (gofr.go:287).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import signal
+import time
+from typing import Any, Callable
+
+from .config.env import EnvConfig
+from .container.container import Container
+from .context import Context
+from .handler import build_core_handler
+from .http.middleware import (
+    cors_middleware,
+    logging_middleware,
+    metrics_middleware,
+    tracer_middleware,
+)
+from .http.responder import ResponseData
+from .http.router import Router
+from .http.server import HTTPServer, chain
+
+DEFAULT_HTTP_PORT = 8000
+DEFAULT_METRICS_PORT = 2121
+DEFAULT_SHUTDOWN_GRACE = 30.0
+
+
+class App:
+    def __init__(self, config_dir: str = "configs", config=None) -> None:
+        self.config = config if config is not None else EnvConfig(config_dir)
+        self.container = Container.create(self.config)
+        self.logger = self.container.logger
+        self.router = Router()
+        self._on_start: list[Callable] = []
+        self._on_shutdown: list[Callable] = []
+        self._subscriptions: dict[str, Callable] = {}
+        self._cron = None  # created on first add_cron_job
+        self._middlewares: list[Callable] = []
+        self._user_middlewares: list[Callable] = []
+        self._stop_event: asyncio.Event | None = None
+        self._servers: list[HTTPServer] = []
+        self._tasks: list[asyncio.Task] = []
+        self.http_server: HTTPServer | None = None
+        self.metrics_server: HTTPServer | None = None
+        self._upgrade_handler = None  # installed by websocket support
+
+        self.http_port = self.config.get_int("HTTP_PORT", DEFAULT_HTTP_PORT) \
+            if hasattr(self.config, "get_int") else DEFAULT_HTTP_PORT
+        self.metrics_port = self.config.get_int("METRICS_PORT", DEFAULT_METRICS_PORT) \
+            if hasattr(self.config, "get_int") else DEFAULT_METRICS_PORT
+        timeout = self.config.get_float("REQUEST_TIMEOUT", 0.0) \
+            if hasattr(self.config, "get_float") else 0.0
+        self.request_timeout = timeout if timeout > 0 else None
+        self.shutdown_grace = self.config.get_float(
+            "SHUTDOWN_GRACE_PERIOD", DEFAULT_SHUTDOWN_GRACE) \
+            if hasattr(self.config, "get_float") else DEFAULT_SHUTDOWN_GRACE
+
+        self._register_default_routes()
+
+    # ------------------------------------------------------------- routes
+    def _register_default_routes(self) -> None:
+        self.router.add("GET", "/.well-known/health", self._health_handler)
+        self.router.add("GET", "/.well-known/alive", self._alive_handler)
+
+    @staticmethod
+    def _alive_handler(ctx: Context) -> Any:
+        return {"status": "UP"}
+
+    def _health_handler(self, ctx: Context) -> Any:
+        return self.container.health()
+
+    def _add_route(self, method: str, pattern: str,
+                   handler: Callable | None = None):
+        if handler is None:  # decorator form
+            def decorator(fn: Callable) -> Callable:
+                self.router.add(method, pattern, fn)
+                return fn
+            return decorator
+        self.router.add(method, pattern, handler)
+        return handler
+
+    def get(self, pattern: str, handler: Callable | None = None):
+        return self._add_route("GET", pattern, handler)
+
+    def post(self, pattern: str, handler: Callable | None = None):
+        return self._add_route("POST", pattern, handler)
+
+    def put(self, pattern: str, handler: Callable | None = None):
+        return self._add_route("PUT", pattern, handler)
+
+    def patch(self, pattern: str, handler: Callable | None = None):
+        return self._add_route("PATCH", pattern, handler)
+
+    def delete(self, pattern: str, handler: Callable | None = None):
+        return self._add_route("DELETE", pattern, handler)
+
+    def add_static_files(self, url_prefix: str, directory: str) -> None:
+        self.router.add_static(url_prefix, directory)
+
+    def use_middleware(self, middleware: Callable) -> None:
+        """Append a user middleware (runs innermost, after the chain)."""
+        self._user_middlewares.append(middleware)
+
+    # ------------------------------------------------------------ hooks
+    def on_start(self, hook: Callable) -> Callable:
+        self._on_start.append(hook)
+        return hook
+
+    def on_shutdown(self, hook: Callable) -> Callable:
+        self._on_shutdown.append(hook)
+        return hook
+
+    def subscribe(self, topic: str, handler: Callable | None = None):
+        if handler is None:
+            def decorator(fn: Callable) -> Callable:
+                self._subscriptions[topic] = fn
+                return fn
+            return decorator
+        self._subscriptions[topic] = handler
+        return handler
+
+    def add_cron_job(self, schedule: str, name: str, job: Callable) -> None:
+        from .cron import Cron
+        if self._cron is None:
+            self._cron = Cron(self.container)
+        self._cron.add(schedule, name, job)
+
+    def migrate(self, migrations: dict) -> None:
+        from .migrations.runner import run as run_migrations
+        run_migrations(self.container, migrations)
+
+    # ---------------------------------------------------------- lifecycle
+    def _build_http_handler(self):
+        core = build_core_handler(self.router, self.container,
+                                  self.request_timeout)
+        middlewares = [
+            tracer_middleware(self.container.tracer),
+            logging_middleware(self.logger),
+            cors_middleware(self.config),
+            metrics_middleware(self.container.metrics),
+        ]
+        middlewares.extend(self._middlewares)
+        middlewares.extend(self._user_middlewares)
+        return chain(middlewares, core)
+
+    def _build_metrics_handler(self):
+        async def metrics_handler(request) -> ResponseData:
+            if request.path == "/metrics":
+                self.container.metrics.set_gauge(
+                    "app_uptime_seconds",
+                    round(time.time() - self.container._start_time, 1))
+                text = self.container.metrics.render_prometheus()
+                return ResponseData(
+                    status=200, body=text.encode(),
+                    content_type="text/plain; version=0.0.4; charset=utf-8")
+            if request.path == "/.well-known/alive":
+                return ResponseData(status=200, body=b'{"status": "UP"}')
+            return ResponseData(status=404, body=b"not found",
+                                content_type="text/plain")
+        return metrics_handler
+
+    async def _run_start_hooks(self) -> bool:
+        """Sequential, abort on error (reference gofr.go:54-88)."""
+        import inspect
+        for hook in self._on_start:
+            try:
+                try:
+                    takes_container = len(inspect.signature(hook).parameters) >= 1
+                except (TypeError, ValueError):
+                    takes_container = False
+                result = hook(self.container) if takes_container else hook()
+                if hasattr(result, "__await__"):
+                    await result
+            except Exception as exc:
+                self.logger.error(f"on_start hook failed: {exc!r}")
+                return False
+        return True
+
+    async def start(self) -> None:
+        """Boot all servers without blocking (for tests / embedding)."""
+        self._stop_event = asyncio.Event()
+        if not await self._run_start_hooks():
+            raise RuntimeError("on_start hook failed")
+
+        handler = self._build_http_handler()
+        self.http_server = HTTPServer(
+            handler, host="0.0.0.0", port=self.http_port, logger=self.logger,
+            upgrade_handler=self._upgrade_handler)
+        await self.http_server.start()
+        self._servers.append(self.http_server)
+
+        self.metrics_server = HTTPServer(
+            self._build_metrics_handler(), host="0.0.0.0",
+            port=self.metrics_port, logger=self.logger)
+        await self.metrics_server.start()
+        self._servers.append(self.metrics_server)
+
+        if self._subscriptions:
+            from .pubsub.subscriber import SubscriptionManager
+            manager = SubscriptionManager(self.container)
+            for topic, fn in self._subscriptions.items():
+                self._tasks.append(asyncio.ensure_future(
+                    manager.start_subscriber(topic, fn)))
+
+        if self._cron is not None:
+            self._tasks.append(asyncio.ensure_future(self._cron.run()))
+
+        self.logger.info(
+            f"{self.container.app_name} up: http={self.http_server.bound_port} "
+            f"metrics={self.metrics_server.bound_port}")
+
+    async def stop(self) -> None:
+        for hook in self._on_shutdown:
+            try:
+                result = hook()
+                if hasattr(result, "__await__"):
+                    await result
+            except Exception as exc:
+                self.logger.warn(f"shutdown hook: {exc!r}")
+        for task in self._tasks:
+            task.cancel()
+        for server in self._servers:
+            await server.shutdown()
+        self._servers.clear()
+        await self.container.close()
+        if self._stop_event is not None:
+            self._stop_event.set()
+
+    async def serve(self) -> None:
+        """start() then block until a stop signal."""
+        await self.start()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, self._signal_stop)
+            except (NotImplementedError, RuntimeError):
+                pass
+        assert self._stop_event is not None
+        await self._stop_event.wait()
+
+    def _signal_stop(self) -> None:
+        self.logger.info("shutdown signal received")
+        asyncio.ensure_future(self._graceful_stop())
+
+    async def _graceful_stop(self) -> None:
+        try:
+            await asyncio.wait_for(self.stop(), self.shutdown_grace)
+        except asyncio.TimeoutError:
+            self.logger.error("graceful shutdown timed out; forcing exit")
+            if self._stop_event is not None:
+                self._stop_event.set()
+
+    def run(self) -> None:
+        """Blocking entry point (reference run.go:15)."""
+        try:
+            asyncio.run(self.serve())
+        except KeyboardInterrupt:
+            pass
+
+
+def new_app(config_dir: str = "configs", config=None) -> App:
+    return App(config_dir=config_dir, config=config)
+
+
+def new_cmd(config_dir: str = "configs", config=None):
+    """CLI application factory (reference factory.go:81)."""
+    from .cli.cmd import CMDApp
+    return CMDApp(config_dir=config_dir, config=config)

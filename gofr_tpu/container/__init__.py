@@ -1,0 +1,4 @@
+from .container import Container
+from .mock import MockContainer, new_mock_container
+
+__all__ = ["Container", "MockContainer", "new_mock_container"]

@@ -1,0 +1,162 @@
+"""The dependency-injection hub every handler Context carries.
+
+The analog of the reference's ``Container`` (pkg/gofr/container/container.go:43-177):
+one struct holding the logger, config, metrics manager, tracer,
+registered inter-service HTTP clients, pub/sub client, datasources
+(SQL/KV/file), and — the TPU-native addition with no reference
+counterpart — the device registry + model runtimes served by this
+process. ``Container.create`` wires everything from config the same
+way ``container.Create`` does (env-driven, container.go:92-177).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from ..config.env import DictConfig
+from ..logging.logger import Logger, level_from_string, new_logger
+from ..metrics.registry import Manager as MetricsManager
+from ..tracing.tracer import ConsoleExporter, InMemoryExporter, Tracer
+
+STATUS_UP = "UP"
+STATUS_DOWN = "DOWN"
+STATUS_DEGRADED = "DEGRADED"
+
+
+class Container:
+    def __init__(self, config=None, logger: Logger | None = None) -> None:
+        self.config = config if config is not None else DictConfig()
+        self.logger = logger if logger is not None else new_logger()
+        self.app_name = "gofr-app"
+        self.app_version = "dev"
+        self.metrics: MetricsManager = MetricsManager(self.logger)
+        self.tracer: Tracer = Tracer(service_name=self.app_name)
+        self.services: dict[str, Any] = {}   # name -> service.HTTPService
+        self.pubsub: Any = None              # pubsub client
+        self.sql: Any = None                 # SQL datasource
+        self.kv: Any = None                  # key-value store
+        self.file: Any = None                # file store
+        self.ws_manager: Any = None          # websocket connection manager
+        self.tpu: Any = None                 # TPU device registry / runtime
+        self.models: dict[str, Any] = {}     # name -> serving engine
+        self._start_time = time.time()
+
+    # ------------------------------------------------------------ factory
+    @classmethod
+    def create(cls, config) -> "Container":
+        log_level = level_from_string(config.get_or_default("LOG_LEVEL", "INFO"))
+        logger = new_logger(level=log_level)
+        c = cls(config=config, logger=logger)
+        c.app_name = config.get_or_default("APP_NAME", "gofr-app")
+        c.app_version = config.get_or_default("APP_VERSION", "dev")
+
+        c.metrics = MetricsManager(logger)
+        c.register_framework_metrics()
+
+        ratio = config.get_float("TRACER_RATIO", 1.0) if hasattr(config, "get_float") else 1.0
+        exporter_kind = config.get_or_default("TRACE_EXPORTER", "none").lower()
+        exporter = None
+        if exporter_kind in ("console", "gofr"):
+            exporter = ConsoleExporter(logger)
+        elif exporter_kind == "memory":
+            exporter = InMemoryExporter()
+        c.tracer = Tracer(service_name=c.app_name, exporter=exporter, ratio=ratio)
+
+        # Datasources connect lazily via add_* (reference external_db.go);
+        # env-driven defaults mirror container.go:128-174.
+        return c
+
+    # ------------------------------------------------- framework metrics
+    def register_framework_metrics(self) -> None:
+        """The standard metric set (reference container.go:252-284)."""
+        m = self.metrics
+        m.new_gauge("app_info", "static app info")
+        m.set_gauge("app_info", 1, app_name=self.app_name, app_version=self.app_version)
+        m.new_gauge("app_uptime_seconds", "seconds since boot")
+        m.new_histogram("app_http_response", "http response time in seconds")
+        m.new_histogram("app_http_service_response",
+                        "outbound http client response time in seconds")
+        m.new_histogram("app_sql_stats", "sql query time in seconds",
+                        buckets=(0.00005, 0.0001, 0.0005, 0.001, 0.005, 0.01,
+                                 0.05, 0.1, 0.5, 1, 5, 30))
+        m.new_histogram("app_kv_stats", "kv op time in seconds",
+                        buckets=(0.00005, 0.0001, 0.0005, 0.001, 0.005, 0.01,
+                                 0.05, 0.1, 0.5, 1, 5, 30))
+        m.new_histogram("app_pubsub_publish_latency", "publish time in seconds")
+        m.new_counter("app_pubsub_publish_total_count", "messages published")
+        m.new_counter("app_pubsub_publish_success_count", "publishes succeeded")
+        m.new_counter("app_pubsub_subscribe_total_count", "messages received")
+        m.new_counter("app_pubsub_subscribe_success_count", "messages handled")
+        # TPU-native series (no reference counterpart)
+        m.new_gauge("app_tpu_hbm_bytes_used", "HBM bytes in use per device")
+        m.new_gauge("app_tpu_device_count", "visible TPU devices")
+        m.new_histogram("app_tpu_execute_seconds", "device execute wall time",
+                        buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                                 0.05, 0.1, 0.25, 0.5, 1, 5))
+        m.new_histogram("app_chat_ttft_seconds", "time to first token",
+                        buckets=(0.005, 0.01, 0.025, 0.05, 0.075, 0.1, 0.15,
+                                 0.25, 0.5, 1, 2, 5))
+
+    # ------------------------------------------------------------- health
+    def health(self) -> dict[str, Any]:
+        """Aggregate health over every attached capability
+        (reference container/health.go:8-98)."""
+        details: dict[str, Any] = {
+            "name": self.app_name,
+            "version": self.app_version,
+            "uptime_seconds": round(time.time() - self._start_time, 1),
+        }
+        statuses: list[str] = []
+        checks: dict[str, Any] = {}
+        for name in ("sql", "kv", "file", "pubsub", "tpu"):
+            source = getattr(self, name)
+            if source is None:
+                continue
+            checks[name] = self._check_one(source)
+            statuses.append(checks[name].get("status", STATUS_DOWN))
+        for svc_name, svc in self.services.items():
+            checks[f"service:{svc_name}"] = self._check_one(svc)
+            statuses.append(checks[f"service:{svc_name}"].get("status", STATUS_DOWN))
+        status = STATUS_UP
+        if any(s != STATUS_UP for s in statuses):
+            status = STATUS_DEGRADED
+        return {"status": status, "details": details, "checks": checks}
+
+    def _check_one(self, source: Any) -> dict[str, Any]:
+        try:
+            check = getattr(source, "health_check", None)
+            if check is None:
+                return {"status": STATUS_UP}
+            result = check()
+            if isinstance(result, dict):
+                return result
+            return {"status": STATUS_UP if result else STATUS_DOWN}
+        except Exception as exc:
+            return {"status": STATUS_DOWN, "error": str(exc)}
+
+    # ------------------------------------------------------ registration
+    def register_service(self, name: str, service: Any) -> None:
+        self.services[name] = service
+
+    def get_http_service(self, name: str) -> Any:
+        return self.services.get(name)
+
+    def add_model(self, name: str, engine: Any) -> None:
+        self.models[name] = engine
+
+    def get_model(self, name: str) -> Any:
+        return self.models.get(name)
+
+    async def close(self) -> None:
+        for attr in ("sql", "kv", "file", "pubsub", "tpu"):
+            source = getattr(self, attr)
+            closer = getattr(source, "close", None)
+            if closer is None:
+                continue
+            try:
+                result = closer()
+                if hasattr(result, "__await__"):
+                    await result
+            except Exception as exc:
+                self.logger.warn(f"closing {attr}: {exc}")

@@ -1,0 +1,82 @@
+"""Mock container — the centerpiece of the test strategy.
+
+Mirrors reference ``NewMockContainer`` (container/mock_container.go:93-160):
+a full container whose every capability is an in-memory fake with call
+recording, so handler tests run hermetically. SQL is backed by
+in-memory sqlite, KV by a dict, pub/sub by an in-process broker, and
+the TPU slot by a CPU-backed fake runtime — the "miniredis for the
+device layer" SURVEY §4 calls for.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..config.env import DictConfig
+from ..logging.logger import DEBUG, MockLogger
+from ..tracing.tracer import InMemoryExporter, Tracer
+from .container import Container
+
+
+class CallRecorder:
+    """Records method calls; configurable canned results/raises."""
+
+    def __init__(self, name: str = "mock") -> None:
+        self._name = name
+        self.calls: list[tuple[str, tuple, dict]] = []
+        self._results: dict[str, Any] = {}
+        self._raises: dict[str, BaseException] = {}
+
+    def expect(self, method: str, result: Any = None,
+               raises: BaseException | None = None) -> None:
+        if raises is not None:
+            self._raises[method] = raises
+        else:
+            self._results[method] = result
+
+    def calls_to(self, method: str) -> list[tuple[tuple, dict]]:
+        return [(a, k) for m, a, k in self.calls if m == method]
+
+    def __getattr__(self, method: str):
+        if method.startswith("_"):
+            raise AttributeError(method)
+
+        def call(*args: Any, **kwargs: Any) -> Any:
+            self.calls.append((method, args, kwargs))
+            if method in self._raises:
+                raise self._raises[method]
+            return self._results.get(method)
+        return call
+
+
+class MockContainer(Container):
+    def __init__(self, config: DictConfig | None = None) -> None:
+        super().__init__(config=config or DictConfig(),
+                         logger=MockLogger(level=DEBUG))
+        self.register_framework_metrics()
+        self.trace_exporter = InMemoryExporter()
+        self.tracer = Tracer(service_name="mock-app", exporter=self.trace_exporter)
+        self.mocks: dict[str, CallRecorder] = {}
+
+    def mock(self, slot: str) -> CallRecorder:
+        """Install a CallRecorder at a container slot and return it."""
+        recorder = self.mocks.get(slot)
+        if recorder is None:
+            recorder = CallRecorder(slot)
+            self.mocks[slot] = recorder
+            setattr(self, slot, recorder)
+        return recorder
+
+    def mock_service(self, name: str) -> CallRecorder:
+        recorder = CallRecorder(f"service:{name}")
+        self.services[name] = recorder
+        self.mocks[f"service:{name}"] = recorder
+        return recorder
+
+    @property
+    def log_lines(self) -> list[dict]:
+        return self.logger.lines  # type: ignore[attr-defined]
+
+
+def new_mock_container() -> MockContainer:
+    return MockContainer()

@@ -1,0 +1,104 @@
+"""The single handler-facing object: request + container + trace logger.
+
+Mirrors reference pkg/gofr/context.go:18-38: a ``Context`` embeds the
+transport-independent request, the full DI container (so ``ctx.sql``,
+``ctx.kv``, ``ctx.get_http_service`` work), a trace-correlated logger,
+``trace()`` for user spans (context.go:62), and ``bind`` (context.go:74).
+The TPU additions: ``ctx.model(name)`` returns a serving engine and
+``ctx.tpu`` the device registry.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .container.container import Container
+from .logging.logger import ContextLogger
+
+
+class Context:
+    def __init__(self, request: Any, container: Container,
+                 responder: Any = None, terminal: Any = None) -> None:
+        self.request = request
+        self.container = container
+        self.responder = responder
+        self.terminal = terminal
+        self.logger = ContextLogger(container.logger)
+        self._auth_info: dict[str, Any] = {}
+
+    # -- request surface (reference context delegates to Request)
+    def bind(self, target: Any = None) -> Any:
+        return self.request.bind(target)
+
+    def param(self, key: str) -> str:
+        return self.request.param(key)
+
+    def params(self, key: str) -> list[str]:
+        return self.request.params(key)
+
+    def path_param(self, key: str) -> str:
+        return self.request.path_param(key)
+
+    def header(self, key: str) -> str:
+        getter = getattr(self.request, "header", None)
+        return getter(key) if getter else ""
+
+    def host_name(self) -> str:
+        return self.request.host_name()
+
+    # -- container surface
+    @property
+    def config(self):
+        return self.container.config
+
+    @property
+    def metrics(self):
+        return self.container.metrics
+
+    @property
+    def sql(self):
+        return self.container.sql
+
+    @property
+    def kv(self):
+        return self.container.kv
+
+    @property
+    def file(self):
+        return self.container.file
+
+    @property
+    def pubsub(self):
+        return self.container.pubsub
+
+    @property
+    def tpu(self):
+        return self.container.tpu
+
+    def model(self, name: str) -> Any:
+        return self.container.get_model(name)
+
+    def get_http_service(self, name: str) -> Any:
+        return self.container.get_http_service(name)
+
+    # -- tracing (reference context.go:62)
+    def trace(self, name: str):
+        return self.container.tracer.start_span(name)
+
+    def get_correlation_id(self) -> str:
+        span = self.container.tracer.current_span()
+        return span.trace_id if span else ""
+
+    # -- auth info set by auth middleware (reference context.go:121)
+    @property
+    def auth_info(self) -> dict[str, Any]:
+        return self._auth_info
+
+    def set_auth_info(self, info: dict[str, Any]) -> None:
+        self._auth_info = dict(info)
+
+    # -- publish convenience
+    async def publish(self, topic: str, message: bytes | str | dict) -> None:
+        if self.container.pubsub is None:
+            raise RuntimeError("no pub/sub client configured")
+        await self.container.pubsub.publish(topic, message)

@@ -1,0 +1,123 @@
+"""Handler execution: the HTTP hot path.
+
+Mirrors reference pkg/gofr/handler.go:55-113: build a Context, run the
+user handler under a request timeout with panic recovery, distinguish
+timeout (408) from handler error, then render through the Responder.
+Async-native: async handlers run on the loop; sync handlers are pushed
+to a thread so they cannot stall the serving event loop (the goroutine
+race of the reference mapped onto asyncio).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import json
+import traceback
+from typing import Any, Callable
+
+from .container.container import Container
+from .context import Context
+from .http.errors import (
+    ErrorInvalidRoute,
+    ErrorMethodNotAllowed,
+    ErrorPanicRecovery,
+    ErrorRequestTimeout,
+    status_and_level_for,
+)
+from .http.request import BindError, HTTPRequest
+from .http.responder import Responder, ResponseData
+from .http.router import Router
+
+HandlerFunc = Callable[[Context], Any]
+
+_FAVICON = bytes.fromhex(
+    "89504e470d0a1a0a0000000d494844520000001000000010080600000028cf"
+    "6282000000264944415478da63fcffff3f0335803851f9ff47cd1c3573d4cc"
+    "51334733473523cd0400ba573b7e1c9b8e1a0000000049454e44ae426082")
+
+
+async def run_handler(handler: HandlerFunc, ctx: Context,
+                      timeout: float | None = None) -> Any:
+    """Run a user handler (sync or async) with an optional timeout."""
+    if inspect.iscoroutinefunction(handler):
+        coro = handler(ctx)
+    else:
+        loop = asyncio.get_running_loop()
+        # copy_context so contextvars (trace ids for logging) survive the
+        # hop into the worker thread
+        import contextvars
+        cvs = contextvars.copy_context()
+        coro = loop.run_in_executor(None, cvs.run, handler, ctx)
+    if timeout is not None and timeout > 0:
+        return await asyncio.wait_for(coro, timeout)
+    return await coro
+
+
+def build_core_handler(router: Router, container: Container,
+                       request_timeout: float | None = None) -> Callable:
+    """The innermost server handler: route -> context -> execute -> respond."""
+    responder = Responder()
+
+    async def core(request: HTTPRequest) -> ResponseData:
+        matched = router.match(request.method, request.path)
+
+        # static mounts serve paths no dynamic route claims
+        # (reference gofr.go:314-339); dynamic routes win on overlap so a
+        # '/' mount cannot shadow the API.
+        if matched is None:
+            static = router.match_static(request.path)
+            if static is not None:
+                status, content, ctype = static
+                return ResponseData(status=int(status), body=content,
+                                    content_type=ctype)
+
+        if request.path == "/favicon.ico" and request.method == "GET":
+            return ResponseData(status=200, body=_FAVICON,
+                                content_type="image/png")
+
+        if matched is None:
+            methods = router.registered_methods_for(request.path)
+            if methods:  # path exists with other verbs -> 405
+                err = ErrorMethodNotAllowed()
+                response = responder.respond(None, err, request.method)
+                response.headers["Allow"] = ", ".join(methods)
+                return response
+            # catch-all 404 listing registered routes (reference handler.go:137)
+            err = ErrorInvalidRoute()
+            response = responder.respond(None, err, request.method)
+            body = json.loads(response.body)
+            body["error"]["registered_routes"] = router.registered_paths()
+            response.body = json.dumps(body).encode()
+            return response
+
+        route, path_params = matched
+        request.set_path_params(path_params)
+        # metrics middleware labels by route pattern, not raw path,
+        # to keep label cardinality bounded
+        request.matched_pattern = route.pattern
+        ctx = Context(request=request, container=container)
+
+        try:
+            result = await run_handler(route.handler, ctx, request_timeout)
+            error = None
+        except asyncio.TimeoutError:
+            result, error = None, ErrorRequestTimeout()
+        except asyncio.CancelledError:
+            raise
+        except BindError as exc:
+            result, error = None, exc
+        except Exception as exc:  # panic recovery (reference handler.go:141)
+            result, error = None, exc
+            if not hasattr(exc, "status_code"):
+                container.logger.error(
+                    f"panic in handler {request.method} {request.path}: {exc!r}",
+                    stack=traceback.format_exc())
+                error = ErrorPanicRecovery()
+
+        if error is not None:
+            _, level = status_and_level_for(error)
+            ctx.logger.log_at(level, f"{request.method} {request.path}: {error}")
+        return responder.respond(result, error, request.method)
+
+    return core

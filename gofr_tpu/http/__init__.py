@@ -1,0 +1,23 @@
+from .errors import (
+    ErrorClientClosedRequest,
+    ErrorEntityAlreadyExists,
+    ErrorEntityNotFound,
+    ErrorInvalidParam,
+    ErrorInvalidRoute,
+    ErrorMissingParam,
+    ErrorPanicRecovery,
+    ErrorRequestTimeout,
+    HTTPError,
+)
+from .request import HTTPRequest
+from .responder import Responder
+from .response import File, Partial, Raw, Redirect, Response, Template
+from .router import Route, Router
+
+__all__ = [
+    "ErrorClientClosedRequest", "ErrorEntityAlreadyExists", "ErrorEntityNotFound",
+    "ErrorInvalidParam", "ErrorInvalidRoute", "ErrorMissingParam",
+    "ErrorPanicRecovery", "ErrorRequestTimeout", "HTTPError",
+    "HTTPRequest", "Responder", "File", "Partial", "Raw", "Redirect",
+    "Response", "Template", "Route", "Router",
+]

@@ -1,0 +1,89 @@
+"""Rich response types a handler can return.
+
+Mirrors reference pkg/gofr/http/response/: ``File``, ``Raw``,
+``Redirect``, ``Template``, and the metadata-carrying ``Response``;
+plus ``Partial`` for the data+error -> 206 policy
+(reference http/responder.go:197-199).
+"""
+
+from __future__ import annotations
+
+import mimetypes
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+
+@dataclass
+class Response:
+    """Data plus optional metadata/headers envelope member."""
+
+    data: Any
+    metadata: dict[str, Any] | None = None
+    headers: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Raw:
+    """Marshal ``data`` as JSON without the ``{"data": ...}`` envelope."""
+
+    data: Any
+
+
+@dataclass
+class File:
+    """Serve bytes with a content type (reference response/file.go)."""
+
+    content: bytes
+    content_type: str = "application/octet-stream"
+
+    @classmethod
+    def from_path(cls, path: str | Path) -> "File":
+        p = Path(path)
+        ctype = mimetypes.guess_type(str(p))[0] or "application/octet-stream"
+        return cls(content=p.read_bytes(), content_type=ctype)
+
+
+@dataclass
+class Redirect:
+    """302 for GET/HEAD, 303 for mutating methods (responder.go:99-110)."""
+
+    url: str
+
+
+@dataclass
+class Template:
+    """Render ``./templates/<name>`` with ``data`` via str.format-style
+    ``$var`` substitution (stdlib string.Template; the reference uses
+    html/template, response/template.go)."""
+
+    name: str
+    data: dict[str, Any] = field(default_factory=dict)
+    directory: str = "templates"
+
+    def render(self) -> str:
+        import string
+        text = (Path(self.directory) / self.name).read_text()
+        return string.Template(text).safe_substitute(
+            {k: str(v) for k, v in self.data.items()})
+
+
+@dataclass
+class Partial:
+    """Data AND error together -> 206 Partial Content."""
+
+    data: Any
+    error: BaseException
+
+
+@dataclass
+class Stream:
+    """Server-sent token stream: an async iterator of chunks.
+
+    The TPU-native addition: ``/chat`` handlers return a ``Stream``
+    whose iterator yields decoded tokens as they leave the device; the
+    server writes them as SSE events (or chunked text).
+    """
+
+    iterator: Any  # AsyncIterator[str | bytes | dict]
+    content_type: str = "text/event-stream"

@@ -1,0 +1,271 @@
+"""End-to-end app tests over real localhost sockets."""
+
+import json
+
+from gofr_tpu.http import ErrorEntityNotFound
+from gofr_tpu.http.response import Stream
+
+from .apputil import AppRunner
+
+
+def build_routes(app):
+    @app.get("/greet")
+    def greet(ctx):
+        name = ctx.param("name") or "world"
+        return f"hello {name}"
+
+    @app.post("/users")
+    def create_user(ctx):
+        data = ctx.bind()
+        return {"created": data["name"]}
+
+    @app.get("/users/{id}")
+    def get_user(ctx):
+        uid = ctx.path_param("id")
+        if uid == "404":
+            raise ErrorEntityNotFound("id", uid)
+        return {"id": uid}
+
+    @app.delete("/users/{id}")
+    def delete_user(ctx):
+        return None
+
+    @app.get("/boom")
+    def boom(ctx):
+        raise RuntimeError("kaboom")
+
+    @app.get("/stream")
+    async def stream(ctx):
+        async def gen():
+            for i in range(3):
+                yield f"tok{i} "
+        return Stream(gen(), content_type="text/plain")
+
+    @app.get("/async")
+    async def async_handler(ctx):
+        return {"mode": "async"}
+
+
+def test_full_request_cycle():
+    with AppRunner(build=build_routes) as app:
+        # GET with query param
+        status, body = app.get_json("/greet?name=tpu")
+        assert status == 200 and body == {"data": "hello tpu"}
+
+        # POST -> 201
+        status, headers, data = app.request("POST", "/users", {"name": "ada"})
+        assert status == 201
+        assert json.loads(data) == {"data": {"created": "ada"}}
+
+        # path params
+        status, body = app.get_json("/users/42")
+        assert status == 200 and body == {"data": {"id": "42"}}
+
+        # typed error -> 404 envelope
+        status, body = app.get_json("/users/404")
+        assert status == 404 and "No entity found" in body["error"]["message"]
+
+        # DELETE -> 204 no body
+        status, _, data = app.request("DELETE", "/users/1")
+        assert status == 204 and data == b""
+
+        # panic recovery -> 500 with generic message (no leak)
+        status, body = app.get_json("/boom")
+        assert status == 500
+        assert body["error"]["message"] == "internal server error"
+
+        # async handler
+        status, body = app.get_json("/async")
+        assert status == 200 and body == {"data": {"mode": "async"}}
+
+
+def test_default_routes_and_errors():
+    with AppRunner(build=build_routes) as app:
+        # health + alive
+        status, body = app.get_json("/.well-known/health")
+        assert status == 200 and body["data"]["status"] == "UP"
+        status, body = app.get_json("/.well-known/alive")
+        assert status == 200 and body["data"] == {"status": "UP"}
+
+        # favicon
+        status, headers, data = app.request("GET", "/favicon.ico")
+        assert status == 200 and data[:4] == b"\x89PNG"
+
+        # 404 with registered routes listed
+        status, body = app.get_json("/nope")
+        assert status == 404
+        assert "/greet" in body["error"]["registered_routes"]
+
+        # 405 with Allow header
+        status, headers, _ = app.request("PUT", "/greet")
+        assert status == 405
+        assert "GET" in headers.get("Allow", "")
+
+        # CORS headers present
+        status, headers, _ = app.request("OPTIONS", "/greet")
+        assert status == 200
+        assert headers.get("Access-Control-Allow-Origin") == "*"
+
+
+def test_streaming_response():
+    with AppRunner(build=build_routes) as app:
+        status, headers, data = app.request("GET", "/stream")
+        assert status == 200
+        assert data == b"tok0 tok1 tok2 "
+        assert headers.get("Transfer-Encoding") == "chunked"
+
+
+def test_metrics_server_scrape():
+    with AppRunner(build=build_routes) as app:
+        app.get_json("/greet")
+        status, headers, data = app.request("GET", "/metrics", port=app.metrics_port)
+        assert status == 200
+        text = data.decode()
+        assert "app_http_response_count" in text
+        assert 'path="/greet"' in text
+        assert "app_info" in text
+
+
+def test_request_log_has_trace_and_status(capsys=None):
+    with AppRunner(build=build_routes) as app:
+        # remote traceparent accepted
+        status, _, _ = app.request(
+            "GET", "/greet",
+            headers={"traceparent": "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"})
+        assert status == 200
+
+
+def test_malformed_request_line():
+    import socket
+    with AppRunner(build=build_routes) as app:
+        s = socket.create_connection(("127.0.0.1", app.port), timeout=5)
+        s.sendall(b"GARBAGE\r\n\r\n")
+        data = s.recv(65536)
+        assert b"400" in data.split(b"\r\n")[0]
+        s.close()
+
+
+def test_keep_alive_two_requests_one_connection():
+    import socket
+    with AppRunner(build=build_routes) as app:
+        s = socket.create_connection(("127.0.0.1", app.port), timeout=5)
+        req = b"GET /greet HTTP/1.1\r\nHost: x\r\n\r\n"
+        s.sendall(req)
+        first = s.recv(65536)
+        assert b"200 OK" in first
+        s.sendall(req)
+        second = s.recv(65536)
+        assert b"200 OK" in second
+        s.close()
+
+
+def test_request_timeout():
+    import time as time_mod
+
+    def build(app):
+        @app.get("/slow")
+        def slow(ctx):
+            time_mod.sleep(2)
+            return "done"
+
+    with AppRunner(config={"REQUEST_TIMEOUT": "0.2"}, build=build) as app:
+        status, body = app.get_json("/slow")
+        assert status == 408
+        assert "timed out" in body["error"]["message"]
+
+
+def test_metrics_label_uses_route_pattern_not_raw_path():
+    with AppRunner(build=build_routes) as app:
+        app.get_json("/users/1")
+        app.get_json("/users/2")
+        app.get_json("/definitely/not/registered")
+        status, _, data = app.request("GET", "/metrics", port=app.metrics_port)
+        text = data.decode()
+        assert 'path="/users/{id}"' in text
+        assert 'path="/users/1"' not in text
+        assert 'path="<unmatched>"' in text
+
+
+def test_static_mount_does_not_shadow_dynamic_routes(tmp_path_factory):
+    site = tmp_path_factory.mktemp("public")
+    (site / "page.html").write_text("<p>static</p>")
+
+    def build(app):
+        app.add_static_files("/", str(site))
+
+        @app.get("/api/users")
+        def users(ctx):
+            return ["ada"]
+
+    with AppRunner(build=build) as app:
+        status, body = app.get_json("/api/users")
+        assert status == 200 and body == {"data": ["ada"]}
+        status, _, data = app.request("GET", "/page.html")
+        assert status == 200 and b"static" in data
+
+
+def test_stream_failure_truncates_without_terminator():
+    import socket
+
+    def build(app):
+        @app.get("/failing-stream")
+        async def failing(ctx):
+            async def gen():
+                yield "tok0 "
+                yield "tok1 "
+                raise RuntimeError("device lost")
+            return Stream(gen(), content_type="text/plain")
+
+    with AppRunner(build=build) as app:
+        s = socket.create_connection(("127.0.0.1", app.port), timeout=5)
+        s.sendall(b"GET /failing-stream HTTP/1.1\r\nHost: x\r\n\r\n")
+        received = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            received = received + chunk
+        s.close()
+        assert b"tok0" in received
+        assert not received.endswith(b"0\r\n\r\n")  # no clean terminator
+
+
+def test_sync_handler_logs_carry_trace_id():
+    from gofr_tpu.logging import MockLogger
+
+    def build(app):
+        mock_log = MockLogger()
+        app.logger = mock_log
+        app.container.logger = mock_log
+
+        @app.get("/traced")
+        def traced(ctx):
+            ctx.logger.info("from inside sync handler")
+            return "ok"
+
+    with AppRunner(build=build) as app:
+        tp = "00-" + "ef" * 16 + "-" + "12" * 8 + "-01"
+        app.request("GET", "/traced", headers={"traceparent": tp})
+        lines = [l for l in app.app.logger.lines
+                 if l.get("message") == "from inside sync handler"]
+        assert lines and lines[0]["trace_id"] == "ef" * 16
+
+
+def test_malformed_timeout_config_still_boots():
+    with AppRunner(config={"REQUEST_TIMEOUT": "30s"}, build=build_routes) as app:
+        status, _ = app.get_json("/greet")
+        assert status == 200
+
+
+def test_on_start_hook_partial_and_failure():
+    import functools
+    seen = []
+
+    def setup(tag, container):
+        seen.append((tag, container is not None))
+
+    def build(app):
+        app.on_start(functools.partial(setup, "db"))
+
+    with AppRunner(build=build) as app:
+        assert seen == [("db", True)]

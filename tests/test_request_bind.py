@@ -1,0 +1,112 @@
+"""Request abstraction tests — params, JSON/form/multipart binding."""
+
+from dataclasses import dataclass, field
+
+import pytest
+
+from gofr_tpu.http.request import BindError, HTTPRequest
+
+
+def make(method="GET", target="/", headers=None, body=b""):
+    return HTTPRequest(method, target, headers or {}, body)
+
+
+def test_query_params():
+    r = make(target="/search?q=llama&tag=a&tag=b&csv=x,y,z&empty=")
+    assert r.param("q") == "llama"
+    assert r.param("missing") == ""
+    assert r.params("tag") == ["a", "b"]
+    assert r.params("csv") == ["x", "y", "z"]
+    assert r.param("empty") == ""
+
+
+def test_path_params_and_host():
+    r = make(target="/users/1", headers={"Host": "api.local:8000"})
+    r.set_path_params({"id": "1"})
+    assert r.path_param("id") == "1"
+    assert r.path_param("nope") == ""
+    assert r.host_name() == "api.local:8000"
+
+
+def test_bind_json_to_dict():
+    r = make("POST", "/x", {"Content-Type": "application/json"},
+             b'{"name": "ada", "age": 37}')
+    assert r.bind() == {"name": "ada", "age": 37}
+
+
+@dataclass
+class Person:
+    name: str
+    age: int
+    tags: list[str] = field(default_factory=list)
+    active: bool = True
+
+
+def test_bind_json_to_dataclass_with_coercion():
+    r = make("POST", "/x", {"Content-Type": "application/json"},
+             b'{"name": "ada", "age": "37", "tags": ["x"], "active": "false", "extra": 1}')
+    p = r.bind(Person)
+    assert p == Person(name="ada", age=37, tags=["x"], active=False)
+
+
+def test_bind_missing_required_field():
+    r = make("POST", "/x", {"Content-Type": "application/json"}, b'{"age": 1}')
+    with pytest.raises(BindError, match="name"):
+        r.bind(Person)
+
+
+def test_bind_invalid_json():
+    r = make("POST", "/x", {"Content-Type": "application/json"}, b"{nope")
+    with pytest.raises(BindError, match="invalid JSON"):
+        r.bind()
+
+
+def test_bind_form_urlencoded():
+    r = make("POST", "/x", {"Content-Type": "application/x-www-form-urlencoded"},
+             b"name=ada&age=37")
+    p = r.bind(Person)
+    assert p.name == "ada" and p.age == 37
+
+
+def test_bind_multipart():
+    boundary = "XBOUND"
+    body = (
+        f"--{boundary}\r\n"
+        'Content-Disposition: form-data; name="name"\r\n\r\n'
+        "ada\r\n"
+        f"--{boundary}\r\n"
+        'Content-Disposition: form-data; name="doc"; filename="a.txt"\r\n'
+        "Content-Type: text/plain\r\n\r\n"
+        "file-bytes-here\r\n"
+        f"--{boundary}--\r\n"
+    ).encode()
+    r = make("POST", "/up",
+             {"Content-Type": f"multipart/form-data; boundary={boundary}"}, body)
+    data = r.bind()
+    assert data["name"] == "ada"
+    assert data["doc"]["filename"] == "a.txt"
+    assert data["doc"]["content"] == b"file-bytes-here"
+    assert data["doc"]["content_type"] == "text/plain"
+
+
+def test_bind_binary_and_text():
+    r = make("POST", "/x", {"Content-Type": "application/octet-stream"}, b"\x01\x02")
+    assert r.bind() == b"\x01\x02"
+    r2 = make("POST", "/x", {"Content-Type": "text/plain"}, b"hello")
+    assert r2.bind() == "hello"
+
+
+def test_nested_dataclass_bind():
+    @dataclass
+    class Address:
+        city: str
+
+    @dataclass
+    class User:
+        name: str
+        address: Address
+
+    r = make("POST", "/x", {"Content-Type": "application/json"},
+             b'{"name": "a", "address": {"city": "zurich"}}')
+    u = r.bind(User)
+    assert u.address.city == "zurich"

@@ -1,0 +1,85 @@
+"""Responder policy tests — the status-code contract from responder.go."""
+
+import json
+
+from gofr_tpu.http import (
+    ErrorEntityNotFound,
+    ErrorInvalidParam,
+    File,
+    Partial,
+    Raw,
+    Redirect,
+    Response,
+)
+from gofr_tpu.http.responder import Responder
+
+r = Responder()
+
+
+def body(resp):
+    return json.loads(resp.body)
+
+
+def test_get_success_envelope():
+    resp = r.respond({"x": 1}, None, "GET")
+    assert resp.status == 200
+    assert body(resp) == {"data": {"x": 1}}
+
+
+def test_post_created():
+    assert r.respond("made", None, "POST").status == 201
+
+
+def test_delete_no_content():
+    resp = r.respond(None, None, "DELETE")
+    assert resp.status == 204
+    assert resp.body == b""
+
+
+def test_error_statuses():
+    resp = r.respond(None, ErrorEntityNotFound("id", "9"), "GET")
+    assert resp.status == 404
+    assert "No entity found with id: 9" in body(resp)["error"]["message"]
+    assert r.respond(None, ErrorInvalidParam("age"), "GET").status == 400
+
+
+def test_unknown_exception_is_500():
+    resp = r.respond(None, RuntimeError("boom"), "GET")
+    assert resp.status == 500
+    assert body(resp)["error"]["message"] == "boom"
+
+
+def test_partial_content():
+    resp = r.respond(Partial(data=[1, 2], error=RuntimeError("replica down")), None, "GET")
+    assert resp.status == 206
+    b = body(resp)
+    assert b["data"] == [1, 2]
+    assert "replica down" in b["error"]["message"]
+
+
+def test_redirect_by_method():
+    assert r.respond(Redirect("/new"), None, "GET").status == 302
+    assert r.respond(Redirect("/new"), None, "POST").status == 303
+    assert r.respond(Redirect("/new"), None, "GET").headers["Location"] == "/new"
+
+
+def test_file_and_raw():
+    resp = r.respond(File(b"PDFDATA", "application/pdf"), None, "GET")
+    assert resp.body == b"PDFDATA" and resp.content_type == "application/pdf"
+    raw = r.respond(Raw([1, 2, 3]), None, "GET")
+    assert json.loads(raw.body) == [1, 2, 3]  # no envelope
+
+
+def test_response_with_metadata_and_headers():
+    resp = r.respond(Response(data={"a": 1}, metadata={"page": 2},
+                              headers={"X-Custom": "v"}), None, "GET")
+    b = body(resp)
+    assert b == {"data": {"a": 1}, "metadata": {"page": 2}}
+    assert resp.headers["X-Custom"] == "v"
+
+
+def test_custom_error_status_code_attr():
+    class TeapotError(Exception):
+        status_code = 418
+
+    assert r.respond(None, TeapotError("short"), "GET").status == 418

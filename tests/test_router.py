@@ -1,0 +1,98 @@
+"""Router tests — pattern matching, params, 405 detection, static serving."""
+
+from gofr_tpu.http.router import Router
+
+
+def handler(ctx):
+    return "ok"
+
+
+def test_exact_match():
+    r = Router()
+    r.add("GET", "/greet", handler)
+    matched = r.match("GET", "/greet")
+    assert matched is not None
+    route, params = matched
+    assert route.pattern == "/greet"
+    assert params == {}
+
+
+def test_path_params():
+    r = Router()
+    r.add("GET", "/users/{id}/posts/{post_id}", handler)
+    route, params = r.match("GET", "/users/42/posts/7")
+    assert params == {"id": "42", "post_id": "7"}
+
+
+def test_no_match_wrong_method_lists_allowed():
+    r = Router()
+    r.add("GET", "/thing", handler)
+    r.add("PUT", "/thing", handler)
+    assert r.match("POST", "/thing") is None
+    assert r.registered_methods_for("/thing") == ["GET", "PUT"]
+
+
+def test_trailing_slash_equivalence():
+    r = Router()
+    r.add("GET", "/a/b", handler)
+    assert r.match("GET", "/a/b/") is not None
+
+
+def test_segment_count_must_match():
+    r = Router()
+    r.add("GET", "/a/{x}", handler)
+    assert r.match("GET", "/a") is None
+    assert r.match("GET", "/a/b/c") is None
+
+
+def test_static_serving_and_traversal_guard(tmp_path):
+    site = tmp_path / "static"
+    site.mkdir()
+    (site / "index.html").write_text("<h1>home</h1>")
+    (site / "app.js").write_text("console.log(1)")
+    (site / ".env").write_text("SECRET=x")
+    (tmp_path / "outside.txt").write_text("secret")
+
+    r = Router()
+    r.add_static("/static", str(site))
+
+    status, content, ctype = r.match_static("/static/index.html")
+    assert status == "200" and b"home" in content and ctype == "text/html"
+
+    status, _, _ = r.match_static("/static/app.js")
+    assert status == "200"
+
+    # directory -> index.html
+    status, content, _ = r.match_static("/static")
+    assert status == "200" and b"home" in content
+
+    # restricted file
+    status, _, _ = r.match_static("/static/.env")
+    assert status == "404"
+
+    # traversal attempt
+    status, _, _ = r.match_static("/static/../outside.txt")
+    assert status == "404"
+
+    # miss entirely different prefix
+    assert r.match_static("/other/file") is None
+
+
+def test_static_404_fallback_page(tmp_path):
+    site = tmp_path / "s"
+    site.mkdir()
+    (site / "404.html").write_text("custom missing page")
+    r = Router()
+    r.add_static("/s", str(site))
+    status, content, ctype = r.match_static("/s/nope.txt")
+    assert status == "404" and b"custom missing" in content and "html" in ctype
+
+
+def test_restricted_directory_contents_blocked(tmp_path):
+    site = tmp_path / "s"
+    (site / ".git").mkdir(parents=True)
+    (site / ".git" / "config").write_text("[remote] url=secret")
+    r = Router()
+    r.add_static("/s", str(site))
+    status, content, _ = r.match_static("/s/.git/config")
+    assert status == "404" and b"secret" not in content
