@@ -50,6 +50,7 @@ class App:
         self._stop_event: asyncio.Event | None = None
         self._servers: list[HTTPServer] = []
         self._tasks: list[asyncio.Task] = []
+        self._shutdown_task: asyncio.Task | None = None
         self.http_server: HTTPServer | None = None
         self.metrics_server: HTTPServer | None = None
         self._upgrade_handler = None  # installed by websocket support
@@ -138,6 +139,23 @@ class App:
     def migrate(self, migrations: dict) -> None:
         from .migrations.runner import run as run_migrations
         run_migrations(self.container, migrations)
+
+    def serve_model(self, name: str, engine, tokenizer=None, *,
+                    chat_path: str | None = "/chat") -> None:
+        """Wire a serving engine into the app: metrics, health, lifecycle,
+        and (optionally) a chat endpoint, in one call."""
+        engine.metrics = self.container.metrics
+        engine.logger = self.logger
+        self.container.add_model(name, engine)
+        if self.container.tpu is None:
+            self.container.tpu = engine
+        if chat_path:
+            from .serving.handlers import make_chat_handler
+            from .serving.tokenizer import ByteTokenizer
+            self.post(chat_path,
+                      make_chat_handler(engine, tokenizer or ByteTokenizer()))
+        self.on_start(lambda c: engine.start())
+        self.on_shutdown(engine.stop)
 
     # ---------------------------------------------------------- lifecycle
     def _build_http_handler(self):
@@ -249,8 +267,12 @@ class App:
         await self._stop_event.wait()
 
     def _signal_stop(self) -> None:
+        if getattr(self, "_shutdown_task", None) is not None:
+            return  # second signal during grace period: already stopping
         self.logger.info("shutdown signal received")
-        asyncio.ensure_future(self._graceful_stop())
+        # strong reference (so GC can't drop it) kept OUTSIDE self._tasks —
+        # stop() cancels everything in _tasks and must not cancel its caller
+        self._shutdown_task = asyncio.ensure_future(self._graceful_stop())
 
     async def _graceful_stop(self) -> None:
         try:

@@ -24,7 +24,9 @@ class CallRecorder:
     def __init__(self, name: str = "mock") -> None:
         self._name = name
         self.calls: list[tuple[str, tuple, dict]] = []
-        self._results: dict[str, Any] = {}
+        # mocked capabilities report healthy unless a test says otherwise,
+        # so health assertions stay hermetic
+        self._results: dict[str, Any] = {"health_check": {"status": "UP"}}
         self._raises: dict[str, BaseException] = {}
 
     def expect(self, method: str, result: Any = None,

@@ -64,17 +64,20 @@ def build_core_handler(router: Router, container: Container,
 
         # static mounts serve paths no dynamic route claims
         # (reference gofr.go:314-339); dynamic routes win on overlap so a
-        # '/' mount cannot shadow the API.
+        # '/' mount cannot shadow the API. A mount's own favicon.ico wins
+        # over the built-in placeholder; a mount 404 for /favicon.ico
+        # falls through to the placeholder.
         if matched is None:
             static = router.match_static(request.path)
-            if static is not None:
+            is_favicon = (request.path == "/favicon.ico"
+                          and request.method in ("GET", "HEAD"))
+            if static is not None and not (is_favicon and static[0] != "200"):
                 status, content, ctype = static
                 return ResponseData(status=int(status), body=content,
                                     content_type=ctype)
-
-        if request.path == "/favicon.ico" and request.method == "GET":
-            return ResponseData(status=200, body=_FAVICON,
-                                content_type="image/png")
+            if is_favicon:
+                return ResponseData(status=200, body=_FAVICON,
+                                    content_type="image/png")
 
         if matched is None:
             methods = router.registered_methods_for(request.path)

@@ -125,7 +125,9 @@ def status_and_level_for(err: BaseException) -> tuple[int, Level]:
     if isinstance(err, HTTPError):
         return err.status_code, err.log_level
     status = getattr(err, "status_code", 500)
-    level = getattr(err, "log_level", ERROR)
     if not isinstance(status, int) or not (100 <= status <= 599):
         status = 500
+    # client errors default to INFO (matching the taxonomy above);
+    # server errors to ERROR
+    level = getattr(err, "log_level", INFO if status < 500 else ERROR)
     return status, level

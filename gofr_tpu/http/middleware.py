@@ -12,7 +12,7 @@ from __future__ import annotations
 import time
 from typing import TextIO
 
-from ..logging.logger import Logger
+from ..logging.logger import Logger, current_trace_ids
 from .request import HTTPRequest
 from .responder import ResponseData
 from .server import Handler, Middleware
@@ -60,19 +60,21 @@ def logging_middleware(logger: Logger) -> Middleware:
     def mw(next_handler: Handler) -> Handler:
         async def wrapped(request: HTTPRequest) -> ResponseData:
             start = time.perf_counter()
+            trace = current_trace_ids()
+            trace_id = trace[0] if trace else ""
             try:
                 response = await next_handler(request)
             except Exception:
                 logger.error(RequestLog(
                     request.method, request.path, 500,
                     int((time.perf_counter() - start) * 1e6),
-                    request.client_addr).__dict__)
+                    request.client_addr, trace_id).__dict__)
                 raise
             if request.path not in WELL_KNOWN_PATHS:  # probe muting
                 record = RequestLog(
                     request.method, request.path, response.status,
                     int((time.perf_counter() - start) * 1e6),
-                    request.client_addr)
+                    request.client_addr, trace_id)
                 if response.status >= 500:
                     logger.error(record)
                 else:

@@ -74,7 +74,8 @@ class Responder:
                                 content_type="text/html; charset=utf-8")
 
         if isinstance(result, Raw):
-            return ResponseData(status=200, body=_json_bytes(result.data))
+            status = {"POST": 201}.get(method, 200)
+            return ResponseData(status=status, body=_json_bytes(result.data))
 
         if isinstance(result, Stream):
             return ResponseData(status=200, stream=result.iterator,

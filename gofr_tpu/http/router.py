@@ -83,6 +83,10 @@ class Router:
             params = self._match_segments(route, path)
             if params is not None and route.method == method:
                 return route, params
+        # HTTP/1.1: HEAD is answered by GET handlers (the server strips
+        # the body via head_only)
+        if method == "HEAD":
+            return self.match("GET", path)
         return None
 
     # -- static files (reference router.go:66-166 checks)

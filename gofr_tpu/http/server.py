@@ -94,7 +94,10 @@ async def read_request(reader: asyncio.StreamReader,
         chunks = []
         total = 0
         while True:
-            size_line = (await reader.readline()).strip()
+            try:
+                size_line = (await reader.readline()).strip()
+            except ValueError as exc:  # LimitOverrunError wrapped: huge line
+                raise HTTPProtocolError(400, "bad chunk framing") from exc
             try:
                 size = int(size_line.split(b";")[0], 16)
             except ValueError as exc:
@@ -204,6 +207,7 @@ class HTTPServer:
                                 writer: asyncio.StreamWriter) -> None:
         peer = writer.get_extra_info("peername")
         client_addr = f"{peer[0]}:{peer[1]}" if isinstance(peer, tuple) else ""
+        took_over = False
         try:
             while True:
                 try:
@@ -223,6 +227,8 @@ class HTTPServer:
                         and "upgrade" in request.headers.get("connection", "").lower()):
                     took_over = await self.upgrade_handler(request, reader, writer)
                     if took_over:
+                        # the upgrade handler (or a task it spawned) now owns
+                        # reader/writer; do not close them here
                         return
                 try:
                     response = await self.handler(request)
@@ -246,11 +252,12 @@ class HTTPServer:
         except (ConnectionResetError, BrokenPipeError, asyncio.IncompleteReadError):
             pass
         finally:
-            try:
-                writer.close()
-                await writer.wait_closed()
-            except (ConnectionResetError, BrokenPipeError):
-                pass
+            if not took_over:
+                try:
+                    writer.close()
+                    await writer.wait_closed()
+                except (ConnectionResetError, BrokenPipeError):
+                    pass
 
 
 def chain(middlewares: list[Middleware], core: Handler) -> Handler:

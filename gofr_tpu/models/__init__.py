@@ -1,0 +1,3 @@
+from .llama import LlamaConfig, llama_decode_step, llama_init, llama_prefill
+
+__all__ = ["LlamaConfig", "llama_decode_step", "llama_init", "llama_prefill"]

@@ -1,0 +1,132 @@
+"""BERT-family encoder — the `/embed` endpoint model.
+
+Bidirectional transformer encoder: learned position + segment
+embeddings, post-LN blocks, GELU MLP, tanh pooler over [CLS].
+Pure-functional with lax.scan over stacked layers like the Llama model.
+
+Serves BASELINE.json config 2 (BERT-base /embed, single chip).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import xla_attention
+from ..ops.norms import layer_norm
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    ffn_dim: int = 3072
+    max_positions: int = 512
+    type_vocab: int = 2
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @classmethod
+    def tiny(cls) -> "BertConfig":
+        return cls(vocab_size=128, dim=32, n_layers=2, n_heads=2,
+                   ffn_dim=64, max_positions=64, dtype=jnp.float32)
+
+    @classmethod
+    def base(cls) -> "BertConfig":
+        return cls()
+
+
+def bert_init(key: jax.Array, config: BertConfig) -> dict:
+    c = config
+    ks = jax.random.split(key, 10)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(c.dtype)
+
+    L = c.n_layers
+    return {
+        "word_embed": (jax.random.normal(ks[0], (c.vocab_size, c.dim),
+                                         jnp.float32) * 0.02).astype(c.dtype),
+        "pos_embed": (jax.random.normal(ks[1], (c.max_positions, c.dim),
+                                        jnp.float32) * 0.02).astype(c.dtype),
+        "type_embed": (jax.random.normal(ks[2], (c.type_vocab, c.dim),
+                                         jnp.float32) * 0.02).astype(c.dtype),
+        "embed_ln_w": jnp.ones((c.dim,), c.dtype),
+        "embed_ln_b": jnp.zeros((c.dim,), c.dtype),
+        "layers": {
+            "wqkv": dense(ks[3], (L, c.dim, 3 * c.dim), c.dim),
+            "wqkv_b": jnp.zeros((L, 3 * c.dim), c.dtype),
+            "wo": dense(ks[4], (L, c.dim, c.dim), c.dim),
+            "wo_b": jnp.zeros((L, c.dim), c.dtype),
+            "ln1_w": jnp.ones((L, c.dim), c.dtype),
+            "ln1_b": jnp.zeros((L, c.dim), c.dtype),
+            "w1": dense(ks[5], (L, c.dim, c.ffn_dim), c.dim),
+            "w1_b": jnp.zeros((L, c.ffn_dim), c.dtype),
+            "w2": dense(ks[6], (L, c.ffn_dim, c.dim), c.ffn_dim),
+            "w2_b": jnp.zeros((L, c.dim), c.dtype),
+            "ln2_w": jnp.ones((L, c.dim), c.dtype),
+            "ln2_b": jnp.zeros((L, c.dim), c.dtype),
+        },
+        "pooler_w": dense(ks[7], (c.dim, c.dim), c.dim),
+        "pooler_b": jnp.zeros((c.dim,), c.dtype),
+    }
+
+
+def bert_encode(params: dict, tokens: jnp.ndarray, config: BertConfig, *,
+                attention_mask: jnp.ndarray | None = None,
+                token_types: jnp.ndarray | None = None
+                ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """tokens [B, S] -> (hidden [B, S, D], pooled [B, D])."""
+    c = config
+    b, s = tokens.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, s), jnp.int32)
+    if token_types is None:
+        token_types = jnp.zeros((b, s), jnp.int32)
+
+    x = (params["word_embed"][tokens]
+         + params["pos_embed"][jnp.arange(s)][None]
+         + params["type_embed"][token_types])
+    x = layer_norm(x, params["embed_ln_w"], params["embed_ln_b"], c.norm_eps)
+
+    lengths = attention_mask.sum(axis=-1).astype(jnp.int32)
+
+    def layer_fn(x, lp):
+        qkv = x @ lp["wqkv"] + lp["wqkv_b"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, c.n_heads, c.head_dim)
+        k = k.reshape(b, s, c.n_heads, c.head_dim)
+        v = v.reshape(b, s, c.n_heads, c.head_dim)
+        attn = xla_attention(q, k, v, causal=False, kv_lengths=lengths)
+        attn = attn.reshape(b, s, c.dim) @ lp["wo"] + lp["wo_b"]
+        x = layer_norm(x + attn, lp["ln1_w"], lp["ln1_b"], c.norm_eps)
+        h = jax.nn.gelu((x @ lp["w1"] + lp["w1_b"]).astype(jnp.float32))
+        h = h.astype(x.dtype) @ lp["w2"] + lp["w2_b"]
+        x = layer_norm(x + h, lp["ln2_w"], lp["ln2_b"], c.norm_eps)
+        return x, None
+
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    pooled = jnp.tanh((x[:, 0] @ params["pooler_w"] + params["pooler_b"])
+                      .astype(jnp.float32)).astype(c.dtype)
+    return x, pooled
+
+
+def mean_pool_embed(hidden: jnp.ndarray, attention_mask: jnp.ndarray
+                    ) -> jnp.ndarray:
+    """Masked mean pooling -> L2-normalized sentence embeddings [B, D]."""
+    mask = attention_mask[..., None].astype(jnp.float32)
+    h = hidden.astype(jnp.float32)
+    summed = (h * mask).sum(axis=1)
+    counts = jnp.maximum(mask.sum(axis=1), 1.0)
+    emb = summed / counts
+    return emb / jnp.maximum(jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-9)

@@ -1,0 +1,226 @@
+"""Llama-3-family decoder — the flagship serving model.
+
+Pure-functional: parameters are a pytree of arrays, the forward passes
+are plain jittable functions. TPU-first structure:
+
+- **lax.scan over layers** with stacked per-layer weights (leading
+  ``L`` axis): one compiled layer body regardless of depth, which keeps
+  XLA compile times flat for 32/80-layer configs and gives the pipeline
+  parallel path its natural stage structure.
+- bf16 params/activations, f32 norms/softmax/logits.
+- GQA + RoPE (Llama-3 scaling), SwiGLU MLP, RMSNorm, optional tied
+  embeddings.
+- Prefill returns the per-layer K/V for cache insertion; decode takes
+  cache [L, B, Smax, Hkv, hd] + per-sequence lengths and updates in
+  place (donated by the engine under jit).
+
+Capability reference: the serving targets of BASELINE.json (Llama-3-8B
+`/chat` on v5e-8, 70B multi-host on v5p-64).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention, decode_attention
+from ..ops.norms import rms_norm
+from ..ops.rope import apply_rope, rope_frequencies
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    max_seq: int = 8192
+    rope_theta: float = 500000.0
+    rope_scaling: dict | None = None
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    # ---- presets -----------------------------------------------------
+    @classmethod
+    def tiny(cls) -> "LlamaConfig":
+        """Test config: runs everywhere in milliseconds."""
+        return cls(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                   n_kv_heads=2, ffn_dim=128, max_seq=128,
+                   dtype=jnp.float32)
+
+    @classmethod
+    def llama3_8b(cls) -> "LlamaConfig":
+        return cls()  # the defaults are the 8B shape
+
+    @classmethod
+    def llama3_70b(cls) -> "LlamaConfig":
+        return cls(dim=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+                   ffn_dim=28672)
+
+    @classmethod
+    def llama3_1b(cls) -> "LlamaConfig":
+        """Llama-3.2-1B shape — the single-chip bench model."""
+        return cls(vocab_size=128256, dim=2048, n_layers=16, n_heads=32,
+                   n_kv_heads=8, ffn_dim=8192, tie_embeddings=True)
+
+    def scaled(self, **kw) -> "LlamaConfig":
+        return replace(self, **kw)
+
+
+# ---------------------------------------------------------------- params
+
+def llama_init(key: jax.Array, config: LlamaConfig) -> dict:
+    """Random-init parameter pytree with stacked layer weights."""
+    c = config
+    hd = c.head_dim
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+
+    def norm_init(shape):
+        return jnp.ones(shape, c.dtype)
+
+    def dense_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(c.dtype)
+
+    lk = jax.random.split(k_layers, 7)
+    L = c.n_layers
+    layers = {
+        "attn_norm": norm_init((L, c.dim)),
+        "wq": dense_init(lk[0], (L, c.dim, c.n_heads * hd), c.dim),
+        "wk": dense_init(lk[1], (L, c.dim, c.n_kv_heads * hd), c.dim),
+        "wv": dense_init(lk[2], (L, c.dim, c.n_kv_heads * hd), c.dim),
+        "wo": dense_init(lk[3], (L, c.n_heads * hd, c.dim), c.n_heads * hd),
+        "ffn_norm": norm_init((L, c.dim)),
+        "w1": dense_init(lk[4], (L, c.dim, c.ffn_dim), c.dim),
+        "w3": dense_init(lk[5], (L, c.dim, c.ffn_dim), c.dim),
+        "w2": dense_init(lk[6], (L, c.ffn_dim, c.dim), c.ffn_dim),
+    }
+    params = {
+        "embed": (jax.random.normal(k_embed, (c.vocab_size, c.dim), jnp.float32)
+                  * 0.02).astype(c.dtype),
+        "layers": layers,
+        "final_norm": norm_init((c.dim,)),
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = dense_init(k_head, (c.dim, c.vocab_size), c.dim)
+    return params
+
+
+def param_count(params: dict) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))
+
+
+# --------------------------------------------------------------- forward
+
+def _attn_block(x, lp, c: LlamaConfig, inv_freq, positions, kv_lengths,
+                implementation):
+    """Self-attention over a full (prefill) block. Returns (out, k, v)."""
+    b, s, _ = x.shape
+    hd = c.head_dim
+    h = rms_norm(x, lp["attn_norm"], c.norm_eps)
+    q = (h @ lp["wq"]).reshape(b, s, c.n_heads, hd)
+    k = (h @ lp["wk"]).reshape(b, s, c.n_kv_heads, hd)
+    v = (h @ lp["wv"]).reshape(b, s, c.n_kv_heads, hd)
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+    out = attention(q, k, v, causal=True, kv_lengths=kv_lengths,
+                    implementation=implementation)
+    out = out.reshape(b, s, c.n_heads * hd) @ lp["wo"]
+    return out, k, v
+
+
+def _mlp_block(x, lp, c: LlamaConfig):
+    h = rms_norm(x, lp["ffn_norm"], c.norm_eps)
+    return (jax.nn.silu((h @ lp["w1"]).astype(jnp.float32))
+            * (h @ lp["w3"]).astype(jnp.float32)).astype(x.dtype) @ lp["w2"]
+
+
+def _logits(params, c: LlamaConfig, x):
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
+    return (x.astype(jnp.float32) @ head.astype(jnp.float32))
+
+
+def llama_prefill(params: dict, tokens: jnp.ndarray, config: LlamaConfig, *,
+                  kv_lengths: jnp.ndarray | None = None,
+                  implementation: str = "auto"
+                  ) -> tuple[jnp.ndarray, tuple[jnp.ndarray, jnp.ndarray]]:
+    """Full-sequence forward.
+
+    tokens [B, S] -> (logits [B, S, V], (k_cache, v_cache) each
+    [L, B, S, Hkv, hd]). ``kv_lengths`` masks right-padded batches.
+    """
+    c = config
+    b, s = tokens.shape
+    inv_freq = rope_frequencies(c.head_dim, c.rope_theta, c.rope_scaling)
+    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    x = params["embed"][tokens]
+
+    def layer_fn(x, lp):
+        attn_out, k, v = _attn_block(x, lp, c, inv_freq, positions,
+                                     kv_lengths, implementation)
+        x = x + attn_out
+        x = x + _mlp_block(x, lp, c)
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(layer_fn, x, params["layers"])
+    return _logits(params, c, x), (ks, vs)
+
+
+def llama_decode_step(params: dict, tokens: jnp.ndarray,
+                      k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+                      lengths: jnp.ndarray, config: LlamaConfig
+                      ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One decode step for a batch of sequences.
+
+    tokens [B] (the latest token per sequence); caches
+    [L, B, Smax, Hkv, hd]; lengths [B] = current kv length per sequence
+    (the new token is written at that position). Returns
+    (logits [B, V], new_k_cache, new_v_cache). The engine donates the
+    caches so XLA updates them in place.
+    """
+    c = config
+    b = tokens.shape[0]
+    hd = c.head_dim
+    inv_freq = rope_frequencies(c.head_dim, c.rope_theta, c.rope_scaling)
+    positions = lengths[:, None]  # [B, 1] — absolute position of new token
+    x = params["embed"][tokens][:, None, :]  # [B, 1, D]
+    batch_idx = jnp.arange(b)
+
+    def layer_fn(x, scanned):
+        lp, kc, vc = scanned
+        h = rms_norm(x, lp["attn_norm"], c.norm_eps)
+        q = (h @ lp["wq"]).reshape(b, 1, c.n_heads, hd)
+        k = (h @ lp["wk"]).reshape(b, 1, c.n_kv_heads, hd)
+        v = (h @ lp["wv"]).reshape(b, 1, c.n_kv_heads, hd)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        kc = kc.at[batch_idx, lengths].set(k[:, 0])
+        vc = vc.at[batch_idx, lengths].set(v[:, 0])
+        out = decode_attention(q, kc, vc, lengths + 1)
+        x = x + (out.reshape(b, 1, c.n_heads * hd) @ lp["wo"])
+        x = x + _mlp_block(x, lp, c)
+        return x, (kc, vc)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_fn, x, (params["layers"], k_cache, v_cache))
+    logits = _logits(params, c, x)[:, 0]  # [B, V]
+    return logits, new_k, new_v
+
+
+def make_empty_cache(config: LlamaConfig, batch: int,
+                     max_seq: int | None = None) -> tuple[jnp.ndarray, jnp.ndarray]:
+    c = config
+    s = max_seq or c.max_seq
+    shape = (c.n_layers, batch, s, c.n_kv_heads, c.head_dim)
+    return jnp.zeros(shape, c.dtype), jnp.zeros(shape, c.dtype)

@@ -1,0 +1,11 @@
+from .norms import layer_norm, rms_norm
+from .rope import apply_rope, rope_frequencies
+from .attention import attention, decode_attention
+from .sampling import sample_tokens
+from .moe import moe_layer, top_k_routing
+
+__all__ = [
+    "layer_norm", "rms_norm", "apply_rope", "rope_frequencies",
+    "attention", "decode_attention", "sample_tokens",
+    "moe_layer", "top_k_routing",
+]

@@ -1,0 +1,63 @@
+"""Mixture-of-experts ops: top-k routing + gated expert MLP.
+
+The dense formulation here computes every expert for every token and
+combines with routing weights — correct, static-shaped, and the
+building block the EP-sharded path reuses: with experts sharded over a
+mesh axis, each device computes only its expert slice of the same
+einsums and the combine is a ``psum`` (see gofr_tpu/parallel).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def top_k_routing(gate_logits: jnp.ndarray, k: int,
+                  renormalize: bool = True) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Route tokens: [T, E] logits -> (weights [T, k], indices [T, k])."""
+    values, indices = jax.lax.top_k(gate_logits, k)
+    if renormalize:
+        weights = jax.nn.softmax(values.astype(jnp.float32), axis=-1)
+    else:
+        weights = jax.nn.softmax(
+            gate_logits.astype(jnp.float32), axis=-1)
+        weights = jnp.take_along_axis(weights, indices, axis=-1)
+    return weights, indices
+
+
+def moe_layer(x: jnp.ndarray, gate_w: jnp.ndarray, w1: jnp.ndarray,
+              w3: jnp.ndarray, w2: jnp.ndarray, *, num_selected: int = 2
+              ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Mixtral-style sparse MLP.
+
+    x [T, Dm]; gate_w [Dm, E]; w1,w3 [E, Dm, F]; w2 [E, F, Dm].
+    Returns (output [T, Dm], router_logits [T, E] for aux loss).
+    """
+    dtype = x.dtype
+    gate_logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)  # [T, E]
+    weights, indices = top_k_routing(gate_logits, num_selected)
+
+    # combine[t, e] = routing weight of expert e for token t (0 if unrouted)
+    num_experts = gate_w.shape[-1]
+    onehot = jax.nn.one_hot(indices, num_experts, dtype=jnp.float32)  # [T,k,E]
+    combine = jnp.einsum("tk,tke->te", weights, onehot)  # [T, E]
+
+    xf = x.astype(jnp.float32)
+    up = jnp.einsum("td,edf->tef", xf, w1.astype(jnp.float32))
+    gate = jnp.einsum("td,edf->tef", xf, w3.astype(jnp.float32))
+    hidden = jax.nn.silu(up) * gate
+    expert_out = jnp.einsum("tef,efd->ted", hidden, w2.astype(jnp.float32))
+    out = jnp.einsum("te,ted->td", combine, expert_out)
+    return out.astype(dtype), gate_logits
+
+
+def load_balancing_loss(router_logits: jnp.ndarray, num_selected: int) -> jnp.ndarray:
+    """Switch-style aux loss: E * sum_e (fraction_tokens_e * mean_prob_e)."""
+    num_experts = router_logits.shape[-1]
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    _, indices = jax.lax.top_k(router_logits, num_selected)
+    counts = jax.nn.one_hot(indices, num_experts).sum(axis=(-3, -2))
+    fraction = counts / jnp.maximum(counts.sum(), 1.0)
+    mean_prob = probs.mean(axis=tuple(range(probs.ndim - 1)))
+    return num_experts * jnp.sum(fraction * mean_prob)

@@ -1,0 +1,49 @@
+"""Rotary position embeddings (RoPE), Llama-3 style.
+
+Supports plain RoPE and Llama-3's frequency scaling for long context.
+Computed in float32; applied as interleaved-free "rotate half" over the
+head dimension (the GPT-NeoX convention Llama uses).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, theta: float = 500000.0,
+                     scaling: dict | None = None) -> jnp.ndarray:
+    """Inverse frequencies [head_dim // 2], optionally Llama-3 scaled.
+
+    ``scaling`` (Llama-3.1 long-context): {"factor": 8, "low_freq_factor": 1,
+    "high_freq_factor": 4, "original_max_position": 8192}.
+    """
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if scaling:
+        factor = float(scaling.get("factor", 8.0))
+        low = float(scaling.get("low_freq_factor", 1.0))
+        high = float(scaling.get("high_freq_factor", 4.0))
+        orig = float(scaling.get("original_max_position", 8192))
+        wavelen = 2.0 * jnp.pi / inv
+        # high-frequency (short wavelength) components keep full rotation;
+        # low-frequency components are slowed by `factor`; in between,
+        # smooth interpolation (Llama-3.1 recipe).
+        smooth = jnp.clip((orig / wavelen - low) / (high - low), 0.0, 1.0)
+        inv = jnp.where(wavelen < orig / high, inv,
+                        jnp.where(wavelen > orig / low, inv / factor,
+                                  (1 - smooth) * inv / factor + smooth * inv))
+    return inv
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray,
+               inv_freq: jnp.ndarray) -> jnp.ndarray:
+    """Rotate ``x`` [..., seq, heads, head_dim] by position.
+
+    ``positions`` is [..., seq] (absolute token positions, so paged /
+    continued decode just passes the running offset).
+    """
+    angles = positions[..., :, None].astype(jnp.float32) * inv_freq  # [..., S, D/2]
+    cos = jnp.cos(angles)[..., :, None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

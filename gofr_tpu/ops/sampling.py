@@ -1,0 +1,47 @@
+"""Token sampling: greedy / temperature / top-k / top-p, jit-friendly.
+
+All shapes static; the sampling mode is baked at trace time (the engine
+buckets requests by sampling config). Gumbel-max sampling avoids an
+explicit categorical draw.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def sample_tokens(logits: jnp.ndarray, key: jax.Array, *,
+                  temperature: float = 1.0,
+                  top_k: int = 0,
+                  top_p: float = 1.0) -> jnp.ndarray:
+    """Sample next tokens from logits [B, V] -> [B] int32.
+
+    temperature == 0.0 -> greedy. top_k/top_p filter before the draw.
+    """
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    logits = logits.astype(jnp.float32) / max(temperature, 1e-6)
+
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]  # [B, 1]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]  # descending
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens until cumulative mass exceeds top_p (always >=1 kept)
+        keep_sorted = jnp.roll(cum, 1, axis=-1) < top_p
+        keep_sorted = keep_sorted.at[..., 0].set(True)
+        # threshold logit: smallest kept logit
+        kept_logits = jnp.where(keep_sorted, sorted_logits, jnp.inf)
+        threshold = jnp.min(kept_logits, axis=-1, keepdims=True)
+        logits = jnp.where(logits < threshold, NEG_INF, logits)
+
+    gumbel = -jnp.log(-jnp.log(
+        jax.random.uniform(key, logits.shape, minval=1e-20, maxval=1.0) + 1e-20))
+    return jnp.argmax(logits + gumbel, axis=-1).astype(jnp.int32)

@@ -1,0 +1,5 @@
+from .engine import Engine, EngineConfig, GenRequest, SamplingParams
+from .tokenizer import ByteTokenizer, Tokenizer
+
+__all__ = ["Engine", "EngineConfig", "GenRequest", "SamplingParams",
+           "ByteTokenizer", "Tokenizer"]

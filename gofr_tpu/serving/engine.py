@@ -1,0 +1,368 @@
+"""Continuous-batching inference engine — the TPU serving hot loop.
+
+The component BASELINE.json's north star adds on top of the GoFr
+surface: requests from any transport (HTTP handler, gRPC stream,
+pub/sub worker) are coalesced in front of the device.
+
+Architecture (one device or one mesh):
+
+- A dedicated **engine thread** owns all device calls, so the asyncio
+  serving loop never blocks on the TPU. Handlers ``submit()`` requests
+  and consume an ``asyncio.Queue`` of tokens bridged via
+  ``loop.call_soon_threadsafe``.
+- **Decode is one fixed-shape jitted step** over ``max_batch`` slots
+  (inactive slots are masked), so XLA compiles exactly one decode
+  graph. KV caches are donated — updated in place in HBM.
+- **Prefill is bucketed** (prompt padded to power-of-two lengths) to
+  bound recompiles; each bucket compiles once.
+- Per-slot sampling params ride as arrays; greedy rows use argmax,
+  stochastic rows use gumbel sampling, selected with ``jnp.where`` so
+  one graph serves every mix.
+- Scheduling: waiting prefills are admitted whenever a slot is free
+  (prefill-priority keeps TTFT low; decode continues for everyone else
+  next step).
+
+This is the slot-based v1 cache (contiguous per-slot rows); the paged
+allocator can replace it behind the same interface.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import queue as queue_mod
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+
+@dataclass
+class SamplingParams:
+    temperature: float = 0.7
+    top_p: float = 1.0
+    top_k: int = 0          # 0 = disabled (static per engine, not per req)
+    max_new_tokens: int = 128
+
+
+@dataclass
+class GenRequest:
+    prompt_tokens: list[int]
+    params: SamplingParams
+    submitted_at: float = field(default_factory=time.time)
+    first_token_at: float | None = None
+    finished_at: float | None = None
+    # engine-internal
+    slot: int = -1
+    generated: list[int] = field(default_factory=list)
+    out_queue: Any = None          # asyncio.Queue[int | None]
+    loop: Any = None               # the submitting event loop
+    error: str | None = None
+
+    def _emit(self, token: int | None) -> None:
+        if self.out_queue is not None and self.loop is not None:
+            self.loop.call_soon_threadsafe(self.out_queue.put_nowait, token)
+
+    @property
+    def ttft_ms(self) -> float | None:
+        if self.first_token_at is None:
+            return None
+        return (self.first_token_at - self.submitted_at) * 1000.0
+
+
+@dataclass
+class EngineConfig:
+    max_batch: int = 8          # decode slots
+    max_seq: int = 1024         # per-slot kv capacity
+    prefill_buckets: tuple = (32, 64, 128, 256, 512, 1024)
+    eos_id: int = -1            # -1: never stop on eos
+    idle_sleep_s: float = 0.001
+
+
+class Engine:
+    """Continuous batching over a (prefill_fn, decode_fn) model pair.
+
+    prefill_fn(params, tokens[1, S], kv_lengths[1]) -> (logits[1, S, V],
+        (k [L,1,S,Hkv,hd], v)) — built from e.g. ``llama_prefill``.
+    decode_fn(params, tokens[B], k_cache, v_cache, lengths[B]) ->
+        (logits[B, V], k_cache, v_cache) — e.g. ``llama_decode_step``.
+    """
+
+    def __init__(self, params: Any, config: EngineConfig, *,
+                 prefill_fn: Callable, decode_fn: Callable,
+                 make_cache: Callable, metrics: Any = None,
+                 logger: Any = None) -> None:
+        self.params = params
+        self.config = config
+        self.metrics = metrics
+        self.logger = logger
+        self._prefill_raw = prefill_fn
+        self._make_cache = make_cache
+
+        cfg = config
+        self._decode = jax.jit(decode_fn, donate_argnums=(2, 3))
+        self._prefill_cache: dict[int, Callable] = {}
+        self._prefill_fn = prefill_fn
+
+        # cache insert donates the caches: an in-place HBM write, not a copy
+        def _insert(kc, vc, k, v, slot):
+            kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
+                                              (0, slot, 0, 0, 0))
+            vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                              (0, slot, 0, 0, 0))
+            return kc, vc
+        self._insert = jax.jit(_insert, donate_argnums=(0, 1))
+
+        self.k_cache, self.v_cache = make_cache(cfg.max_batch, cfg.max_seq)
+        self.lengths = np.zeros(cfg.max_batch, np.int32)       # kv length per slot
+        self.active: list[GenRequest | None] = [None] * cfg.max_batch
+        self.waiting: queue_mod.Queue[GenRequest] = queue_mod.Queue()
+
+        self._rng = jax.random.key(int(time.time() * 1e3) % (2**31))
+        self._running = False
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._step_count = 0
+        self.total_generated = 0
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="gofr-engine")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def health_check(self) -> dict:
+        return {
+            "status": "UP" if self._running else "DOWN",
+            "active_slots": sum(r is not None for r in self.active),
+            "waiting": self.waiting.qsize(),
+            "steps": self._step_count,
+            "total_generated": self.total_generated,
+        }
+
+    def close(self) -> None:
+        self.stop()
+
+    # -------------------------------------------------------------- submit
+    def submit(self, prompt_tokens: list[int],
+               params: SamplingParams | None = None) -> GenRequest:
+        """Called from the asyncio loop; returns a request whose
+        ``out_queue`` yields token ids and then ``None``."""
+        params = params or SamplingParams()
+        # keep the tail of over-long prompts, reserving room to generate
+        room = max(1, min(params.max_new_tokens, self.config.max_seq // 2))
+        limit = max(1, self.config.max_seq - room - 1)
+        if len(prompt_tokens) > limit:
+            prompt_tokens = prompt_tokens[-limit:]
+        req = GenRequest(prompt_tokens=list(prompt_tokens), params=params)
+        try:
+            req.loop = asyncio.get_running_loop()
+            req.out_queue = asyncio.Queue()
+        except RuntimeError:  # submitted from a plain thread (tests/bench)
+            req.loop = None
+            req.out_queue = None
+        self.waiting.put(req)
+        self._wake.set()
+        return req
+
+    def submit_sync(self, prompt_tokens: list[int],
+                    params: SamplingParams | None = None) -> GenRequest:
+        """Blocking submit for non-async callers; returns when finished."""
+        req = self.submit(prompt_tokens, params)
+        while req.finished_at is None and req.error is None:
+            time.sleep(0.002)
+        return req
+
+    async def generate_stream(self, prompt_tokens: list[int],
+                              params: SamplingParams | None = None):
+        """Async iterator of token ids."""
+        req = self.submit(prompt_tokens, params)
+        while True:
+            token = await req.out_queue.get()
+            if token is None:
+                break
+            yield token
+
+    # ---------------------------------------------------------- scheduling
+    def _bucket_for(self, n: int) -> int:
+        for b in self.config.prefill_buckets:
+            if n <= b:
+                return b
+        return self.config.prefill_buckets[-1]
+
+    def _get_prefill(self, bucket: int) -> Callable:
+        fn = self._prefill_cache.get(bucket)
+        if fn is None:
+            fn = jax.jit(self._prefill_fn)
+            self._prefill_cache[bucket] = fn
+        return fn
+
+    def _free_slot(self) -> int:
+        for i, r in enumerate(self.active):
+            if r is None:
+                return i
+        return -1
+
+    def _admit_one(self) -> bool:
+        slot = self._free_slot()
+        if slot < 0:
+            return False
+        try:
+            req = self.waiting.get_nowait()
+        except queue_mod.Empty:
+            return False
+        try:
+            self._prefill_into_slot(req, slot)
+        except Exception as exc:
+            req.error = str(exc)
+            req.finished_at = time.time()
+            req._emit(None)
+            if self.logger:
+                self.logger.error(f"prefill failed: {exc!r}")
+        return True
+
+    def _prefill_into_slot(self, req: GenRequest, slot: int) -> None:
+        n = len(req.prompt_tokens)
+        bucket = self._bucket_for(n)
+        tokens = np.full((1, bucket), 0, np.int32)
+        tokens[0, :n] = req.prompt_tokens
+        kv_len = jnp.array([n], jnp.int32)
+        prefill = self._get_prefill(bucket)
+        logits, (k, v) = prefill(self.params, jnp.asarray(tokens), kv_len)
+        # write prompt kv into the slot (donated, in-place)
+        self.k_cache, self.v_cache = self._insert(
+            self.k_cache, self.v_cache, k, v, slot)
+        # first token from the last prompt position
+        first = self._sample_row(logits[0, n - 1], req)
+        req.slot = slot
+        req.first_token_at = time.time()
+        req.generated.append(first)
+        req._emit(first)
+        self.total_generated += 1
+        self.lengths[slot] = n
+        self.active[slot] = req
+        if self.metrics is not None:
+            self.metrics.record_histogram(
+                "app_chat_ttft_seconds",
+                req.first_token_at - req.submitted_at)
+        if self._finished(req, first):
+            self._retire(slot)
+
+    def _sample_row(self, logits_row: jnp.ndarray, req: GenRequest) -> int:
+        p = req.params
+        self._rng, key = jax.random.split(self._rng)
+        from ..ops.sampling import sample_tokens
+        token = sample_tokens(logits_row[None], key,
+                              temperature=p.temperature,
+                              top_k=p.top_k, top_p=p.top_p)
+        return int(token[0])
+
+    def _finished(self, req: GenRequest, token: int) -> bool:
+        if token == self.config.eos_id:
+            return True
+        return len(req.generated) >= req.params.max_new_tokens
+
+    def _retire(self, slot: int) -> None:
+        req = self.active[slot]
+        if req is None:
+            return
+        req.finished_at = time.time()
+        req._emit(None)
+        self.active[slot] = None
+        self.lengths[slot] = 0
+
+    # -------------------------------------------------------------- decode
+    def _decode_step(self) -> None:
+        cfg = self.config
+        tokens = np.zeros(cfg.max_batch, np.int32)
+        temps = np.zeros(cfg.max_batch, np.float32)
+        top_ps = np.ones(cfg.max_batch, np.float32)
+        active_mask = np.zeros(cfg.max_batch, bool)
+        for i, req in enumerate(self.active):
+            if req is None:
+                continue
+            active_mask[i] = True
+            tokens[i] = req.generated[-1]
+            temps[i] = req.params.temperature
+            top_ps[i] = req.params.top_p
+        if not active_mask.any():
+            return
+
+        lengths = jnp.asarray(self.lengths)
+        self._rng, key = jax.random.split(self._rng)
+        start = time.perf_counter()
+        logits, self.k_cache, self.v_cache = self._decode(
+            self.params, jnp.asarray(tokens), self.k_cache, self.v_cache,
+            lengths)
+        next_tokens = _sample_batch(logits, key, jnp.asarray(temps),
+                                    jnp.asarray(top_ps))
+        next_np = np.asarray(next_tokens)
+        if self.metrics is not None:
+            self.metrics.record_histogram(
+                "app_tpu_execute_seconds", time.perf_counter() - start)
+
+        self._step_count += 1
+        for i, req in enumerate(self.active):
+            if req is None:
+                continue
+            token = int(next_np[i])
+            self.lengths[i] += 1
+            req.generated.append(token)
+            req._emit(token)
+            self.total_generated += 1
+            if self._finished(req, token) or self.lengths[i] >= cfg.max_seq - 1:
+                self._retire(i)
+
+    # ---------------------------------------------------------------- loop
+    def _loop(self) -> None:
+        while self._running:
+            did_work = False
+            # admit as many waiting prefills as slots allow (TTFT priority)
+            while self._admit_one():
+                did_work = True
+            if any(r is not None for r in self.active):
+                self._decode_step()
+                did_work = True
+            if not did_work:
+                self._wake.clear()
+                self._wake.wait(timeout=0.1)
+
+
+def _sample_batch(logits: jnp.ndarray, key: jax.Array,
+                  temperatures: jnp.ndarray, top_ps: jnp.ndarray) -> jnp.ndarray:
+    """Per-row sampling in one graph: greedy rows (temp==0) via argmax,
+    stochastic rows via top-p filtered gumbel draw."""
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    safe_t = jnp.maximum(temperatures, 1e-6)[:, None]
+    scaled = logits / safe_t
+
+    sorted_logits = jnp.sort(scaled, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = jnp.roll(cum, 1, axis=-1) < top_ps[:, None]
+    keep_sorted = keep_sorted.at[..., 0].set(True)
+    kept = jnp.where(keep_sorted, sorted_logits, jnp.inf)
+    threshold = jnp.min(kept, axis=-1, keepdims=True)
+    filtered = jnp.where(scaled < threshold, NEG_INF, scaled)
+
+    gumbel = -jnp.log(-jnp.log(
+        jax.random.uniform(key, scaled.shape, minval=1e-20, maxval=1.0) + 1e-20))
+    sampled = jnp.argmax(filtered + gumbel, axis=-1).astype(jnp.int32)
+    return jnp.where(temperatures <= 0.0, greedy, sampled)

@@ -1,0 +1,73 @@
+"""Model -> Engine glue: build engines from model families."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..models.llama import (
+    LlamaConfig,
+    llama_decode_step,
+    llama_init,
+    llama_prefill,
+    make_empty_cache,
+)
+from .engine import Engine, EngineConfig
+
+
+def llama_engine(params: Any, model_config: LlamaConfig,
+                 engine_config: EngineConfig | None = None, *,
+                 metrics: Any = None, logger: Any = None,
+                 implementation: str = "auto") -> Engine:
+    engine_config = engine_config or EngineConfig()
+    c = model_config
+
+    def prefill_fn(params, tokens, kv_lengths):
+        return llama_prefill(params, tokens, c, kv_lengths=kv_lengths,
+                             implementation=implementation)
+
+    def decode_fn(params, tokens, k_cache, v_cache, lengths):
+        return llama_decode_step(params, tokens, k_cache, v_cache, lengths, c)
+
+    def make_cache(batch, max_seq):
+        return make_empty_cache(c, batch, max_seq=max_seq)
+
+    return Engine(params, engine_config, prefill_fn=prefill_fn,
+                  decode_fn=decode_fn, make_cache=make_cache,
+                  metrics=metrics, logger=logger)
+
+
+def moe_engine(params: Any, model_config, engine_config: EngineConfig | None = None,
+               *, metrics: Any = None, logger: Any = None,
+               implementation: str = "auto") -> Engine:
+    from ..models.moe import moe_decode_step, moe_prefill
+    import jax.numpy as jnp
+    engine_config = engine_config or EngineConfig()
+    c = model_config
+
+    def prefill_fn(params, tokens, kv_lengths):
+        logits, caches, _router = moe_prefill(
+            params, tokens, c, kv_lengths=kv_lengths,
+            implementation=implementation)
+        return logits, caches
+
+    def decode_fn(params, tokens, k_cache, v_cache, lengths):
+        return moe_decode_step(params, tokens, k_cache, v_cache, lengths, c)
+
+    def make_cache(batch, max_seq):
+        shape = (c.n_layers, batch, max_seq, c.n_kv_heads, c.head_dim)
+        return jnp.zeros(shape, c.dtype), jnp.zeros(shape, c.dtype)
+
+    return Engine(params, engine_config, prefill_fn=prefill_fn,
+                  decode_fn=decode_fn, make_cache=make_cache,
+                  metrics=metrics, logger=logger)
+
+
+def demo_llama_engine(engine_config: EngineConfig | None = None,
+                      seed: int = 0, **kw) -> Engine:
+    """Tiny random-weight engine for tests and examples."""
+    import jax
+    c = LlamaConfig.tiny()
+    params = llama_init(jax.random.key(seed), c)
+    return llama_engine(params, c,
+                        engine_config or EngineConfig(max_batch=4, max_seq=128),
+                        implementation="xla", **kw)

@@ -1,0 +1,115 @@
+"""Framework handlers for the serving endpoints: /chat and /embed.
+
+The GoFr-style integration point: ``app.post("/chat",
+make_chat_handler(engine, tokenizer))`` gives an OpenAI-ish completion
+endpoint with SSE streaming; ``make_embed_handler`` serves sentence
+embeddings off a BERT encoder.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any
+
+from ..http.errors import ErrorInvalidParam, ErrorMissingParam
+from ..http.response import Raw, Stream
+from .engine import Engine, SamplingParams
+
+
+def make_chat_handler(engine: Engine, tokenizer: Any):
+    """POST /chat: {"prompt": str, "max_tokens"?, "temperature"?,
+    "top_p"?, "stream"?: bool}"""
+
+    async def chat_handler(ctx):
+        body = ctx.bind() or {}
+        prompt = body.get("prompt")
+        if prompt is None and isinstance(body.get("messages"), list):
+            prompt = "\n".join(str(m.get("content", ""))
+                               for m in body["messages"])
+        if not prompt or not isinstance(prompt, str):
+            raise ErrorMissingParam("prompt")
+        try:
+            params = SamplingParams(
+                temperature=float(body.get("temperature", 0.7)),
+                top_p=float(body.get("top_p", 1.0)),
+                max_new_tokens=int(body.get("max_tokens", 128)),
+            )
+        except (TypeError, ValueError) as exc:
+            raise ErrorInvalidParam("temperature/top_p/max_tokens") from exc
+        if params.max_new_tokens < 1 or params.max_new_tokens > 4096:
+            raise ErrorInvalidParam("max_tokens")
+
+        prompt_tokens = tokenizer.encode(prompt)
+        stream = bool(body.get("stream", False))
+
+        if stream:
+            async def sse():
+                async for token in engine.generate_stream(prompt_tokens, params):
+                    text = tokenizer.decode([token])
+                    yield ("data: " + json.dumps({"token": token, "text": text})
+                           + "\n\n")
+                yield "data: [DONE]\n\n"
+            return Stream(sse())
+
+        req = engine.submit(prompt_tokens, params)
+        tokens: list[int] = []
+        while True:
+            token = await req.out_queue.get()
+            if token is None:
+                break
+            tokens.append(token)
+        if req.error:
+            raise RuntimeError(f"generation failed: {req.error}")
+        return {
+            "text": tokenizer.decode(tokens),
+            "tokens": tokens,
+            "usage": {
+                "prompt_tokens": len(prompt_tokens),
+                "completion_tokens": len(tokens),
+                "ttft_ms": round(req.ttft_ms, 2) if req.ttft_ms else None,
+            },
+        }
+
+    return chat_handler
+
+
+def make_embed_handler(params: Any, config: Any, tokenizer: Any, *,
+                       max_len: int = 512, buckets=(16, 32, 64, 128, 256, 512)):
+    """POST /embed: {"input": str | [str]} -> {"embeddings": [[...]]}"""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models.bert import bert_encode, mean_pool_embed
+
+    @jax.jit
+    def encode(tokens, mask):
+        hidden, _ = bert_encode(params, tokens, config, attention_mask=mask)
+        return mean_pool_embed(hidden, mask)
+
+    def embed_handler(ctx):
+        body = ctx.bind() or {}
+        texts = body.get("input")
+        if isinstance(texts, str):
+            texts = [texts]
+        if not texts or not isinstance(texts, list):
+            raise ErrorMissingParam("input")
+        start = time.perf_counter()
+        token_lists = [tokenizer.encode(t)[:max_len] for t in texts]
+        longest = max(len(t) for t in token_lists)
+        bucket = next((b for b in buckets if longest <= b), buckets[-1])
+        batch = np.zeros((len(texts), bucket), np.int32)
+        mask = np.zeros((len(texts), bucket), np.int32)
+        for i, toks in enumerate(token_lists):
+            toks = toks[:bucket]
+            batch[i, :len(toks)] = toks
+            mask[i, :len(toks)] = 1
+        emb = np.asarray(encode(jnp.asarray(batch), jnp.asarray(mask)))
+        return Raw({
+            "embeddings": [e.tolist() for e in emb.astype(float)],
+            "dim": int(emb.shape[-1]),
+            "latency_ms": round((time.perf_counter() - start) * 1000, 2),
+        })
+
+    return embed_handler

@@ -1,0 +1,115 @@
+"""Tokenizers for the serving path.
+
+``ByteTokenizer`` is the dependency-free default: UTF-8 bytes + special
+tokens, reversible for any text, vocab 260. Real deployments load a BPE
+vocabulary via ``BPETokenizer.from_files`` (tiktoken-format); the hot
+merge loop has a C++ fast path (gofr_tpu/native) with this pure-Python
+fallback.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Protocol
+
+
+class Tokenizer(Protocol):
+    bos_id: int
+    eos_id: int
+    pad_id: int
+    vocab_size: int
+
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: list[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer: ids 0..255 are bytes, then specials."""
+
+    def __init__(self) -> None:
+        self.pad_id = 256
+        self.bos_id = 257
+        self.eos_id = 258
+        self.unk_id = 259
+        self.vocab_size = 260
+
+    def encode(self, text: str, *, bos: bool = True) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.bos_id] + ids) if bos else ids
+
+    def decode(self, ids: list[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", "replace")
+
+
+class BPETokenizer:
+    """Byte-pair tokenizer over a rank table (tiktoken file format:
+    ``base64(token_bytes) rank`` per line)."""
+
+    def __init__(self, ranks: dict[bytes, int],
+                 specials: dict[str, int] | None = None) -> None:
+        self.ranks = ranks
+        self.specials = dict(specials or {})
+        base = len(ranks)
+        self.bos_id = self.specials.setdefault("<|bos|>", base)
+        self.eos_id = self.specials.setdefault("<|eos|>", base + 1)
+        self.pad_id = self.specials.setdefault("<|pad|>", base + 2)
+        self.vocab_size = base + len(self.specials)
+        self._decode_table: dict[int, bytes] = {v: k for k, v in ranks.items()}
+        self._native = None
+        try:
+            from ..native import bpe as native_bpe
+            self._native = native_bpe.load(ranks)
+        except Exception:
+            self._native = None
+
+    @classmethod
+    def from_files(cls, ranks_path: str | Path,
+                   specials_path: str | Path | None = None) -> "BPETokenizer":
+        import base64
+        ranks: dict[bytes, int] = {}
+        for line in Path(ranks_path).read_text().splitlines():
+            if not line.strip():
+                continue
+            token_b64, rank = line.split()
+            ranks[base64.b64decode(token_b64)] = int(rank)
+        specials = None
+        if specials_path and Path(specials_path).is_file():
+            specials = json.loads(Path(specials_path).read_text())
+        return cls(ranks, specials)
+
+    def _bpe_merge(self, piece: bytes) -> list[int]:
+        """Greedy lowest-rank merging (pure-Python fallback)."""
+        parts: list[bytes] = [piece[i:i + 1] for i in range(len(piece))]
+        while len(parts) > 1:
+            best_rank = None
+            best_i = -1
+            for i in range(len(parts) - 1):
+                rank = self.ranks.get(parts[i] + parts[i + 1])
+                if rank is not None and (best_rank is None or rank < best_rank):
+                    best_rank, best_i = rank, i
+            if best_rank is None:
+                break
+            parts[best_i:best_i + 2] = [parts[best_i] + parts[best_i + 1]]
+        out = []
+        for p in parts:
+            rank = self.ranks.get(p)
+            if rank is not None:
+                out.append(rank)
+            else:  # unmergeable byte without a rank: skip (lossy, rare)
+                out.extend(r for r in (self.ranks.get(p[i:i+1])
+                                       for i in range(len(p))) if r is not None)
+        return out
+
+    def encode(self, text: str, *, bos: bool = True) -> list[int]:
+        data = text.encode("utf-8")
+        if self._native is not None:
+            ids = self._native.encode(data)
+        else:
+            ids = self._bpe_merge(data)
+        return ([self.bos_id] + ids) if bos else ids
+
+    def decode(self, ids: list[int]) -> str:
+        chunks = [self._decode_table.get(i, b"") for i in ids]
+        return b"".join(chunks).decode("utf-8", "replace")

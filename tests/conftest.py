@@ -1,15 +1,30 @@
 """Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
 
 Multi-chip hardware is not available in CI; sharding/parallelism tests
-run against ``--xla_force_host_platform_device_count=8`` CPU devices, the
-standard JAX pattern for testing Mesh/pjit code paths.  Must run before
-jax is imported anywhere.
+run against ``--xla_force_host_platform_device_count=8`` CPU devices,
+the standard JAX pattern for testing Mesh/pjit code paths.
+
+The environment ships with the 'axon' TPU plugin, which wins over the
+``JAX_PLATFORMS`` env var alone — ``jax.config.update`` is what
+actually pins the backend. A developer explicitly exporting
+``JAX_PLATFORMS`` to something other than the ambient 'axon' keeps
+their choice.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("GOFR_TELEMETRY", "false")
+
+# default to cpu unless the developer explicitly exported something else;
+# the config.update must run unconditionally because the env var alone
+# does not override the axon plugin
+_platform = os.environ.get("JAX_PLATFORMS", "axon")
+if _platform == "axon":
+    _platform = "cpu"
+os.environ["JAX_PLATFORMS"] = _platform
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)

@@ -1,6 +1,7 @@
 """End-to-end app tests over real localhost sockets."""
 
 import json
+import time
 
 from gofr_tpu.http import ErrorEntityNotFound
 from gofr_tpu.http.response import Stream
@@ -269,3 +270,45 @@ def test_on_start_hook_partial_and_failure():
 
     with AppRunner(build=build) as app:
         assert seen == [("db", True)]
+
+
+def test_head_request_served_by_get_route():
+    with AppRunner(build=build_routes) as app:
+        status, headers, data = app.request("HEAD", "/greet")
+        assert status == 200
+        assert data == b""
+        assert int(headers.get("Content-Length", -1)) > 0
+
+
+def test_graceful_stop_via_signal_handler_path():
+    """_signal_stop must complete shutdown (not cancel itself)."""
+    import asyncio
+
+    with AppRunner(build=build_routes) as app:
+        loop = app._loop
+
+        def trigger():
+            app.app._signal_stop()
+
+        loop.call_soon_threadsafe(trigger)
+        deadline = time.time() + 10
+        while time.time() < deadline and not app.app._stop_event.is_set():
+            time.sleep(0.05)
+        assert app.app._stop_event.is_set()
+
+
+def test_static_mount_favicon_wins_over_builtin(tmp_path_factory):
+    site = tmp_path_factory.mktemp("fav")
+    (site / "favicon.ico").write_bytes(b"REAL-ICON-BYTES")
+
+    def build(app):
+        app.add_static_files("/", str(site))
+
+    with AppRunner(build=build) as app:
+        status, _, data = app.request("GET", "/favicon.ico")
+        assert status == 200 and data == b"REAL-ICON-BYTES"
+
+    # and without a mount, the builtin placeholder serves
+    with AppRunner(build=build_routes) as app:
+        status, _, data = app.request("GET", "/favicon.ico")
+        assert status == 200 and data[:4] == b"\x89PNG"

@@ -1,0 +1,119 @@
+"""Continuous-batching engine tests (tiny model, CPU)."""
+
+import threading
+import time
+
+import pytest
+
+from gofr_tpu.serving.engine import EngineConfig, SamplingParams
+from gofr_tpu.serving.glue import demo_llama_engine
+from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = demo_llama_engine(EngineConfig(max_batch=4, max_seq=128))
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def test_single_generation(engine):
+    req = engine.submit_sync([1, 2, 3],
+                             SamplingParams(temperature=0.0, max_new_tokens=8))
+    assert len(req.generated) == 8
+    assert req.error is None
+    assert req.ttft_ms is not None and req.ttft_ms >= 0
+    assert req.finished_at is not None
+
+
+def test_greedy_determinism(engine):
+    a = engine.submit_sync([5, 6, 7],
+                           SamplingParams(temperature=0.0, max_new_tokens=10))
+    b = engine.submit_sync([5, 6, 7],
+                           SamplingParams(temperature=0.0, max_new_tokens=10))
+    assert a.generated == b.generated
+
+
+def test_concurrent_requests_all_complete(engine):
+    reqs = []
+    for i in range(8):  # 2x the slot count -> queueing must work
+        reqs.append(engine.submit(
+            [1 + i, 2, 3],
+            SamplingParams(temperature=0.0, max_new_tokens=6)))
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if all(r.finished_at is not None for r in reqs):
+            break
+        time.sleep(0.01)
+    assert all(r.finished_at is not None for r in reqs)
+    assert all(len(r.generated) == 6 for r in reqs)
+
+
+def test_batched_identical_to_solo(engine):
+    """Continuous batching must not change greedy outputs."""
+    solo = engine.submit_sync([9, 8, 7],
+                              SamplingParams(temperature=0.0, max_new_tokens=6))
+    others = [engine.submit([3 + i, 1, 4],
+                            SamplingParams(temperature=0.7, max_new_tokens=12))
+              for i in range(3)]
+    batched = engine.submit_sync([9, 8, 7],
+                                 SamplingParams(temperature=0.0, max_new_tokens=6))
+    deadline = time.time() + 60
+    while time.time() < deadline and any(r.finished_at is None for r in others):
+        time.sleep(0.01)
+    assert solo.generated == batched.generated
+
+
+def test_long_prompt_truncated(engine):
+    req = engine.submit_sync(list(range(1, 200)) * 2,
+                             SamplingParams(temperature=0.0, max_new_tokens=4))
+    assert req.error is None
+    assert len(req.generated) == 4
+
+
+def test_health_check(engine):
+    health = engine.health_check()
+    assert health["status"] == "UP"
+    assert health["total_generated"] > 0
+
+
+def test_max_seq_stops_generation(engine):
+    # prompt near the cap: generation must stop at max_seq, not crash
+    req = engine.submit_sync(list(range(1, 120)),
+                             SamplingParams(temperature=0.0, max_new_tokens=50))
+    assert req.error is None
+    assert 0 < len(req.generated) <= 50
+
+
+def test_stochastic_sampling_varies(engine):
+    outs = set()
+    for i in range(4):
+        req = engine.submit_sync([1, 2],
+                                 SamplingParams(temperature=5.0, top_p=1.0,
+                                                max_new_tokens=8))
+        outs.add(tuple(req.generated))
+    assert len(outs) > 1  # very high temperature -> variety
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    text = "hello TPU — ünïcode ✓"
+    ids = tok.encode(text)
+    assert ids[0] == tok.bos_id
+    assert tok.decode(ids) == text
+
+
+def test_submit_from_thread_without_loop(engine):
+    result = {}
+
+    def worker():
+        req = engine.submit_sync([2, 4, 6],
+                                 SamplingParams(temperature=0.0,
+                                                max_new_tokens=3))
+        result["tokens"] = req.generated
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join(60)
+    assert len(result["tokens"]) == 3

@@ -1,0 +1,142 @@
+"""Model tests: prefill/decode consistency, masking, shapes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gofr_tpu.models.bert import BertConfig, bert_encode, bert_init, mean_pool_embed
+from gofr_tpu.models.llama import (
+    LlamaConfig,
+    llama_decode_step,
+    llama_init,
+    llama_prefill,
+    make_empty_cache,
+    param_count,
+)
+from gofr_tpu.models.moe import MoEConfig, moe_decode_step, moe_init, moe_prefill
+
+
+def test_llama_prefill_shapes():
+    c = LlamaConfig.tiny()
+    params = llama_init(jax.random.key(0), c)
+    tokens = jax.random.randint(jax.random.key(1), (2, 10), 0, c.vocab_size)
+    logits, (k, v) = llama_prefill(params, tokens, c, implementation="xla")
+    assert logits.shape == (2, 10, c.vocab_size)
+    assert k.shape == (c.n_layers, 2, 10, c.n_kv_heads, c.head_dim)
+    assert v.shape == k.shape
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_llama_decode_matches_prefill():
+    """Teacher-forced prefill logits == step-by-step decode logits."""
+    c = LlamaConfig.tiny()
+    params = llama_init(jax.random.key(0), c)
+    b, s = 2, 12
+    tokens = jax.random.randint(jax.random.key(1), (b, s), 0, c.vocab_size)
+
+    full_logits, _ = llama_prefill(params, tokens, c, implementation="xla")
+
+    # prefill the first 4 tokens, then decode the rest one at a time
+    prefix = 4
+    _, (k, v) = llama_prefill(params, tokens[:, :prefix], c, implementation="xla")
+    k_cache, v_cache = make_empty_cache(c, b, max_seq=s + 4)
+    k_cache = k_cache.at[:, :, :prefix].set(k)
+    v_cache = v_cache.at[:, :, :prefix].set(v)
+
+    lengths = jnp.full((b,), prefix, jnp.int32)
+    for t in range(prefix, s):
+        logits, k_cache, v_cache = llama_decode_step(
+            params, tokens[:, t], k_cache, v_cache, lengths, c)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(full_logits[:, t]),
+            rtol=2e-4, atol=2e-4)
+        lengths = lengths + 1
+
+
+def test_llama_padded_batch_masking():
+    """Padding tokens beyond kv_lengths must not change real rows."""
+    c = LlamaConfig.tiny()
+    params = llama_init(jax.random.key(0), c)
+    tokens = jax.random.randint(jax.random.key(1), (1, 6), 0, c.vocab_size)
+    padded = jnp.pad(tokens, ((0, 0), (0, 4)), constant_values=7)
+    lengths = jnp.array([6], jnp.int32)
+    logits_plain, _ = llama_prefill(params, tokens, c, implementation="xla")
+    logits_padded, _ = llama_prefill(params, padded, c,
+                                     kv_lengths=lengths, implementation="xla")
+    np.testing.assert_allclose(np.asarray(logits_padded[:, :6]),
+                               np.asarray(logits_plain), rtol=1e-4, atol=1e-4)
+
+
+def test_llama_param_counts_match_architecture():
+    c = LlamaConfig.llama3_8b()
+    hd = c.head_dim
+    expected = (
+        c.vocab_size * c.dim                       # embed
+        + c.n_layers * (
+            2 * c.dim                              # norms
+            + c.dim * c.n_heads * hd               # wq
+            + 2 * c.dim * c.n_kv_heads * hd        # wk, wv
+            + c.n_heads * hd * c.dim               # wo
+            + 3 * c.dim * c.ffn_dim)               # w1, w3, w2
+        + c.dim                                    # final norm
+        + c.dim * c.vocab_size)                    # lm head
+    # ~8.03B for the 8B config
+    assert abs(expected - 8.03e9) / 8.03e9 < 0.01
+    tiny = LlamaConfig.tiny()
+    params = llama_init(jax.random.key(0), tiny)
+    assert param_count(params) > 0
+
+
+def test_bert_encode_and_pooling():
+    c = BertConfig.tiny()
+    params = bert_init(jax.random.key(0), c)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, c.vocab_size)
+    mask = jnp.ones((2, 16), jnp.int32).at[1, 8:].set(0)
+    hidden, pooled = bert_encode(params, tokens, c, attention_mask=mask)
+    assert hidden.shape == (2, 16, c.dim)
+    assert pooled.shape == (2, c.dim)
+    emb = mean_pool_embed(hidden, mask)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(emb), axis=-1),
+                               1.0, rtol=1e-5)
+
+
+def test_bert_mask_blocks_padding_influence():
+    c = BertConfig.tiny()
+    params = bert_init(jax.random.key(0), c)
+    tokens = jax.random.randint(jax.random.key(1), (1, 8), 0, c.vocab_size)
+    mask = jnp.ones((1, 8), jnp.int32)
+    hidden_a, _ = bert_encode(params, tokens, c, attention_mask=mask)
+    # change tokens beyond the mask; valid positions must be unaffected
+    padded_tokens = jnp.pad(tokens, ((0, 0), (0, 4)), constant_values=3)
+    padded_mask = jnp.pad(mask, ((0, 0), (0, 4)))
+    hidden_b, _ = bert_encode(params, padded_tokens, c,
+                              attention_mask=padded_mask)
+    np.testing.assert_allclose(np.asarray(hidden_b[:, :8]),
+                               np.asarray(hidden_a), rtol=1e-4, atol=1e-4)
+
+
+def test_moe_prefill_decode_consistency():
+    c = MoEConfig.tiny()
+    params = moe_init(jax.random.key(0), c)
+    b, s = 1, 8
+    tokens = jax.random.randint(jax.random.key(1), (b, s), 0, c.vocab_size)
+    full_logits, (k, v), router = moe_prefill(params, tokens, c,
+                                              implementation="xla")
+    assert router.shape == (c.n_layers, b, s, c.n_experts)
+
+    prefix = 3
+    _, (kp, vp), _ = moe_prefill(params, tokens[:, :prefix], c,
+                                 implementation="xla")
+    smax = s + 2
+    kc = jnp.zeros((c.n_layers, b, smax, c.n_kv_heads, c.head_dim), c.dtype)
+    vc = jnp.zeros_like(kc)
+    kc = kc.at[:, :, :prefix].set(kp)
+    vc = vc.at[:, :, :prefix].set(vp)
+    lengths = jnp.full((b,), prefix, jnp.int32)
+    for t in range(prefix, s):
+        logits, kc, vc = moe_decode_step(params, tokens[:, t], kc, vc,
+                                         lengths, c)
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(full_logits[:, t]),
+                                   rtol=2e-4, atol=2e-4)
+        lengths = lengths + 1

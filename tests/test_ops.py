@@ -1,0 +1,227 @@
+"""Ops tests on the virtual CPU backend (pallas in interpret mode)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.ops import (
+    apply_rope,
+    attention,
+    decode_attention,
+    layer_norm,
+    moe_layer,
+    rms_norm,
+    rope_frequencies,
+    sample_tokens,
+    top_k_routing,
+)
+from gofr_tpu.ops.attention import xla_attention
+
+
+def test_rms_norm_matches_reference():
+    x = jax.random.normal(jax.random.key(0), (2, 5, 64))
+    w = jax.random.normal(jax.random.key(1), (64,)) * 0.1 + 1.0
+    got = rms_norm(x, w)
+    expected = x / np.sqrt((np.asarray(x) ** 2).mean(-1, keepdims=True) + 1e-6) * np.asarray(w)
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-5)
+
+
+def test_layer_norm_zero_mean_unit_var():
+    x = jax.random.normal(jax.random.key(0), (3, 7, 32)) * 5 + 3
+    out = layer_norm(x, jnp.ones(32), jnp.zeros(32))
+    np.testing.assert_allclose(np.asarray(out).mean(-1), 0.0, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(out).std(-1), 1.0, atol=1e-3)
+
+
+def test_rope_preserves_norm_and_relative_property():
+    d = 64
+    inv = rope_frequencies(d, theta=10000.0)
+    x = jax.random.normal(jax.random.key(0), (1, 6, 2, d))
+    pos = jnp.arange(6)[None, :]
+    rotated = apply_rope(x, pos, inv)
+    # rotation preserves vector norms
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(rotated), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5)
+    # dot products depend only on relative distance
+    q = jax.random.normal(jax.random.key(1), (1, 1, 1, d))
+    k = jax.random.normal(jax.random.key(2), (1, 1, 1, d))
+    def dot_at(pq, pk):
+        rq = apply_rope(q, jnp.array([[pq]]), inv)
+        rk = apply_rope(k, jnp.array([[pk]]), inv)
+        return float(jnp.sum(rq * rk))
+    assert dot_at(3, 1) == pytest.approx(dot_at(12, 10), rel=1e-4)
+    assert dot_at(0, 0) == pytest.approx(dot_at(9, 9), rel=1e-4)
+
+
+def test_rope_llama3_scaling_changes_low_freqs_only():
+    d = 128
+    base = rope_frequencies(d)
+    scaled = rope_frequencies(d, scaling={"factor": 8, "low_freq_factor": 1,
+                                          "high_freq_factor": 4,
+                                          "original_max_position": 8192})
+    base, scaled = np.asarray(base), np.asarray(scaled)
+    assert np.allclose(scaled[:8], base[:8])        # high freq intact
+    assert np.allclose(scaled[-8:], base[-8:] / 8)  # low freq slowed 8x
+
+
+def test_xla_attention_causal_masking():
+    b, s, h, d = 1, 8, 2, 16
+    q = jax.random.normal(jax.random.key(0), (b, s, h, d))
+    k = jax.random.normal(jax.random.key(1), (b, s, h, d))
+    v = jax.random.normal(jax.random.key(2), (b, s, h, d))
+    out_full = xla_attention(q, k, v, causal=True)
+    # changing future kv must not affect past outputs
+    k2 = k.at[:, -1].set(99.0)
+    v2 = v.at[:, -1].set(99.0)
+    out_mod = xla_attention(q, k2, v2, causal=True)
+    np.testing.assert_allclose(np.asarray(out_full[:, :-1]),
+                               np.asarray(out_mod[:, :-1]), rtol=1e-5)
+
+
+def test_gqa_matches_repeated_heads():
+    b, s, d = 2, 8, 16
+    hq, hkv = 8, 2
+    q = jax.random.normal(jax.random.key(0), (b, s, hq, d))
+    k = jax.random.normal(jax.random.key(1), (b, s, hkv, d))
+    v = jax.random.normal(jax.random.key(2), (b, s, hkv, d))
+    out = xla_attention(q, k, v, causal=True)
+    k_rep = jnp.repeat(k, hq // hkv, axis=2)
+    v_rep = jnp.repeat(v, hq // hkv, axis=2)
+    out_rep = xla_attention(q, k_rep, v_rep, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_rep), rtol=1e-5)
+
+
+def test_flash_attention_matches_xla():
+    b, s, hq, hkv, d = 2, 256, 4, 2, 128
+    q = jax.random.normal(jax.random.key(0), (b, s, hq, d), dtype=jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (b, s, hkv, d), dtype=jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (b, s, hkv, d), dtype=jnp.float32)
+    ref = xla_attention(q, k, v, causal=True)
+    got = attention(q, k, v, causal=True, implementation="interpret",
+                    block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-2, atol=1e-2)
+
+
+def test_flash_attention_respects_kv_lengths():
+    b, s, h, d = 2, 128, 2, 128
+    q = jax.random.normal(jax.random.key(0), (b, s, h, d))
+    k = jax.random.normal(jax.random.key(1), (b, s, h, d))
+    v = jax.random.normal(jax.random.key(2), (b, s, h, d))
+    lengths = jnp.array([128, 64], dtype=jnp.int32)
+    ref = xla_attention(q, k, v, causal=True, kv_lengths=lengths)
+    got = attention(q, k, v, causal=True, kv_lengths=lengths,
+                    implementation="interpret", block_q=64, block_k=64)
+    # rows beyond a sequence's length are padding; compare valid region
+    np.testing.assert_allclose(np.asarray(got[0]), np.asarray(ref[0]),
+                               rtol=2e-2, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(got[1, :64]), np.asarray(ref[1, :64]),
+                               rtol=2e-2, atol=1e-2)
+
+
+def test_flash_attention_non_multiple_seq_len():
+    b, s, h, d = 1, 100, 2, 128  # not a multiple of block sizes
+    q = jax.random.normal(jax.random.key(0), (b, s, h, d))
+    k = jax.random.normal(jax.random.key(1), (b, s, h, d))
+    v = jax.random.normal(jax.random.key(2), (b, s, h, d))
+    ref = xla_attention(q, k, v, causal=True)
+    got = attention(q, k, v, causal=True, implementation="interpret",
+                    block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-2, atol=1e-2)
+
+
+def test_decode_attention_matches_full_attention_last_row():
+    b, smax, hq, hkv, d = 2, 32, 4, 2, 16
+    cur_lens = jnp.array([10, 20], dtype=jnp.int32)
+    k_cache = jax.random.normal(jax.random.key(1), (b, smax, hkv, d))
+    v_cache = jax.random.normal(jax.random.key(2), (b, smax, hkv, d))
+    q = jax.random.normal(jax.random.key(0), (b, 1, hq, d))
+    got = decode_attention(q, k_cache, v_cache, cur_lens)
+    for i, ln in enumerate([10, 20]):
+        ref = xla_attention(q[i:i+1], k_cache[i:i+1, :ln], v_cache[i:i+1, :ln],
+                            causal=False)
+        np.testing.assert_allclose(np.asarray(got[i]), np.asarray(ref[0]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_chunked_prefill_q_offset():
+    b, s, h, d = 1, 16, 2, 16
+    q = jax.random.normal(jax.random.key(0), (b, s, h, d))
+    k = jax.random.normal(jax.random.key(1), (b, s, h, d))
+    v = jax.random.normal(jax.random.key(2), (b, s, h, d))
+    full = xla_attention(q, k, v, causal=True)
+    # second half of q attending to full kv with offset
+    part = xla_attention(q[:, 8:], k, v, causal=True, q_offset=8)
+    np.testing.assert_allclose(np.asarray(full[:, 8:]), np.asarray(part),
+                               rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------- sampling
+
+def test_greedy_sampling():
+    logits = jnp.array([[0.1, 5.0, 0.2], [3.0, 0.0, 0.1]])
+    out = sample_tokens(logits, jax.random.key(0), temperature=0.0)
+    assert out.tolist() == [1, 0]
+
+
+def test_top_k_restricts_support():
+    logits = jnp.array([[10.0, 9.0, 1.0, 0.0, -5.0]])
+    draws = [int(sample_tokens(logits, jax.random.key(i), temperature=2.0,
+                               top_k=2)[0]) for i in range(50)]
+    assert set(draws) <= {0, 1}
+    assert len(set(draws)) == 2  # both top-2 seen at high temperature
+
+
+def test_top_p_keeps_at_least_one():
+    logits = jnp.array([[0.0, 0.0, 0.0, 20.0]])
+    draws = {int(sample_tokens(logits, jax.random.key(i), temperature=1.0,
+                               top_p=0.01)[0]) for i in range(20)}
+    assert draws == {3}
+
+
+def test_sampling_follows_distribution():
+    logits = jnp.log(jnp.array([[0.7, 0.2, 0.1]]))
+    counts = np.zeros(3)
+    for i in range(300):
+        counts[int(sample_tokens(logits, jax.random.key(i))[0])] += 1
+    assert counts[0] > counts[1] > counts[2]
+
+
+# -------------------------------------------------------------------- moe
+
+def test_top_k_routing_weights_sum_to_one():
+    logits = jax.random.normal(jax.random.key(0), (10, 8))
+    weights, indices = top_k_routing(logits, 2)
+    np.testing.assert_allclose(np.asarray(weights.sum(-1)), 1.0, rtol=1e-5)
+    assert indices.shape == (10, 2)
+    assert len(set(np.asarray(indices).flatten().tolist())) <= 8
+
+
+def test_moe_layer_single_expert_equals_dense_mlp():
+    t, dm, f = 6, 16, 32
+    x = jax.random.normal(jax.random.key(0), (t, dm))
+    gate_w = jnp.zeros((dm, 1))
+    w1 = jax.random.normal(jax.random.key(1), (1, dm, f)) * 0.1
+    w3 = jax.random.normal(jax.random.key(2), (1, dm, f)) * 0.1
+    w2 = jax.random.normal(jax.random.key(3), (1, f, dm)) * 0.1
+    out, _ = moe_layer(x, gate_w, w1, w3, w2, num_selected=1)
+    expected = (jax.nn.silu(x @ w1[0]) * (x @ w3[0])) @ w2[0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_moe_routes_to_distinct_experts():
+    t, dm, f, e = 32, 8, 16, 4
+    x = jax.random.normal(jax.random.key(0), (t, dm))
+    gate_w = jax.random.normal(jax.random.key(1), (dm, e))
+    w1 = jax.random.normal(jax.random.key(2), (e, dm, f)) * 0.1
+    w3 = jax.random.normal(jax.random.key(3), (e, dm, f)) * 0.1
+    w2 = jax.random.normal(jax.random.key(4), (e, f, dm)) * 0.1
+    out, router_logits = moe_layer(x, gate_w, w1, w3, w2, num_selected=2)
+    assert out.shape == (t, dm)
+    assert router_logits.shape == (t, e)
+    _, idx = top_k_routing(router_logits, 2)
+    assert len(set(np.asarray(idx).flatten().tolist())) > 1

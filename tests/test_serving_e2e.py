@@ -1,0 +1,134 @@
+"""End-to-end serving tests: /chat and /embed through the real HTTP stack."""
+
+import json
+
+import jax
+import pytest
+
+from gofr_tpu.models.bert import BertConfig, bert_init
+from gofr_tpu.serving.engine import EngineConfig
+from gofr_tpu.serving.glue import demo_llama_engine
+from gofr_tpu.serving.handlers import make_chat_handler, make_embed_handler
+from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+from .apputil import AppRunner
+
+
+@pytest.fixture(scope="module")
+def serving_app():
+    tokenizer = ByteTokenizer()
+    engine = demo_llama_engine(EngineConfig(max_batch=4, max_seq=128))
+    engine.start()
+
+    bert_config = BertConfig.tiny()
+    bert_params = bert_init(jax.random.key(0), bert_config)
+
+    def build(app):
+        app.container.add_model("chat", engine)
+        app.container.tpu = engine  # health surface
+        app.post("/chat", make_chat_handler(engine, tokenizer))
+        app.post("/embed", make_embed_handler(bert_params, bert_config, tokenizer))
+
+    runner = AppRunner(build=build)
+    with runner as app:
+        yield app
+    engine.stop()
+
+
+def test_chat_completion(serving_app):
+    status, headers, data = serving_app.request(
+        "POST", "/chat",
+        {"prompt": "hello", "max_tokens": 8, "temperature": 0.0})
+    assert status == 201
+    body = json.loads(data)["data"]
+    assert len(body["tokens"]) == 8
+    assert body["usage"]["completion_tokens"] == 8
+    assert body["usage"]["ttft_ms"] is not None
+    assert isinstance(body["text"], str)
+
+
+def test_chat_streaming_sse(serving_app):
+    status, headers, data = serving_app.request(
+        "POST", "/chat",
+        {"prompt": "stream me", "max_tokens": 5, "temperature": 0.0,
+         "stream": True})
+    assert status == 200  # wait -- streams return 200 via Stream path
+    text = data.decode()
+    events = [line for line in text.split("\n\n") if line.startswith("data: ")]
+    assert events[-1] == "data: [DONE]"
+    token_events = [json.loads(e[len("data: "):]) for e in events[:-1]]
+    assert len(token_events) == 5
+    assert all("token" in e for e in token_events)
+
+
+def test_chat_missing_prompt(serving_app):
+    status, _, data = serving_app.request("POST", "/chat", {"nope": 1})
+    assert status == 400
+    assert "prompt" in json.loads(data)["error"]["message"]
+
+
+def test_chat_bad_params(serving_app):
+    status, _, _ = serving_app.request(
+        "POST", "/chat", {"prompt": "x", "max_tokens": -5})
+    assert status == 400
+    status, _, _ = serving_app.request(
+        "POST", "/chat", {"prompt": "x", "temperature": "hot"})
+    assert status == 400
+
+
+def test_embed_single_and_batch(serving_app):
+    status, _, data = serving_app.request("POST", "/embed", {"input": "hello"})
+    assert status == 201
+    body = json.loads(data)
+    assert len(body["embeddings"]) == 1
+    assert body["dim"] == len(body["embeddings"][0])
+
+    status, _, data = serving_app.request(
+        "POST", "/embed", {"input": ["a", "b", "longer sentence here"]})
+    body = json.loads(data)
+    assert len(body["embeddings"]) == 3
+
+
+def test_embed_missing_input(serving_app):
+    status, _, _ = serving_app.request("POST", "/embed", {})
+    assert status == 400
+
+
+def test_health_shows_engine(serving_app):
+    status, body = serving_app.get_json("/.well-known/health")
+    assert status == 200
+    checks = body["data"]["checks"]
+    assert checks["tpu"]["status"] == "UP"
+    assert checks["tpu"]["total_generated"] >= 0
+
+
+def test_concurrent_chat_over_http(serving_app):
+    import concurrent.futures as futures
+
+    def one(i):
+        status, _, data = serving_app.request(
+            "POST", "/chat",
+            {"prompt": f"req {i}", "max_tokens": 4, "temperature": 0.0})
+        return status, json.loads(data)
+
+    with futures.ThreadPoolExecutor(8) as pool:
+        results = list(pool.map(one, range(8)))
+    assert all(s == 201 for s, _ in results)
+    assert all(len(b["data"]["tokens"]) == 4 for _, b in results)
+
+
+def test_serve_model_wires_metrics_and_health():
+    engine = demo_llama_engine(EngineConfig(max_batch=2, max_seq=64))
+
+    def build(app):
+        app.serve_model("llm", engine, ByteTokenizer())
+
+    with AppRunner(build=build) as app:
+        status, _, data = app.request(
+            "POST", "/chat", {"prompt": "hi", "max_tokens": 3, "temperature": 0.0})
+        assert status == 201
+        status, body = app.get_json("/.well-known/health")
+        assert body["data"]["checks"]["tpu"]["status"] == "UP"
+        _, _, metrics_data = app.request("GET", "/metrics", port=app.metrics_port)
+        assert "app_chat_ttft_seconds_count" in metrics_data.decode()
+    assert engine._running is False  # on_shutdown stopped it
