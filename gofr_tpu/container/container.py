@@ -124,11 +124,24 @@ class Container:
         return {"status": status, "details": details, "checks": checks}
 
     def _check_one(self, source: Any) -> dict[str, Any]:
+        import asyncio
+        import inspect
         try:
             check = getattr(source, "health_check", None)
             if check is None:
                 return {"status": STATUS_UP}
             result = check()
+            if inspect.iscoroutine(result):
+                # works from executor threads AND from inside a running
+                # loop (async handlers): hop to a throwaway thread
+                try:
+                    asyncio.get_running_loop()
+                except RuntimeError:
+                    result = asyncio.run(result)
+                else:
+                    import concurrent.futures
+                    with concurrent.futures.ThreadPoolExecutor(1) as pool:
+                        result = pool.submit(asyncio.run, result).result(10)
             if isinstance(result, dict):
                 return result
             return {"status": STATUS_UP if result else STATUS_DOWN}

@@ -4,9 +4,11 @@ from .errors import (
     ErrorEntityNotFound,
     ErrorInvalidParam,
     ErrorInvalidRoute,
+    ErrorMethodNotAllowed,
     ErrorMissingParam,
     ErrorPanicRecovery,
     ErrorRequestTimeout,
+    ErrorServiceUnavailable,
     HTTPError,
 )
 from .request import HTTPRequest
@@ -17,7 +19,8 @@ from .router import Route, Router
 __all__ = [
     "ErrorClientClosedRequest", "ErrorEntityAlreadyExists", "ErrorEntityNotFound",
     "ErrorInvalidParam", "ErrorInvalidRoute", "ErrorMissingParam",
-    "ErrorPanicRecovery", "ErrorRequestTimeout", "HTTPError",
+    "ErrorMethodNotAllowed", "ErrorPanicRecovery", "ErrorRequestTimeout",
+    "ErrorServiceUnavailable", "HTTPError",
     "HTTPRequest", "Responder", "File", "Partial", "Raw", "Redirect",
     "Response", "Template", "Route", "Router",
 ]

@@ -153,24 +153,32 @@ def _logits(params, c: LlamaConfig, x):
 
 def llama_prefill(params: dict, tokens: jnp.ndarray, config: LlamaConfig, *,
                   kv_lengths: jnp.ndarray | None = None,
-                  implementation: str = "auto"
+                  implementation: str = "auto",
+                  constrain=None
                   ) -> tuple[jnp.ndarray, tuple[jnp.ndarray, jnp.ndarray]]:
     """Full-sequence forward.
 
     tokens [B, S] -> (logits [B, S, V], (k_cache, v_cache) each
     [L, B, S, Hkv, hd]). ``kv_lengths`` masks right-padded batches.
+    ``constrain``: optional fn applied to residual activations — the
+    parallel layer passes a ``with_sharding_constraint`` to pin
+    Megatron-style sequence-parallel layouts between blocks.
     """
     c = config
     b, s = tokens.shape
     inv_freq = rope_frequencies(c.head_dim, c.rope_theta, c.rope_scaling)
     positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
     x = params["embed"][tokens]
+    if constrain is not None:
+        x = constrain(x)
 
     def layer_fn(x, lp):
         attn_out, k, v = _attn_block(x, lp, c, inv_freq, positions,
                                      kv_lengths, implementation)
         x = x + attn_out
         x = x + _mlp_block(x, lp, c)
+        if constrain is not None:
+            x = constrain(x)
         return x, (k, v)
 
     x, (ks, vs) = jax.lax.scan(layer_fn, x, params["layers"])

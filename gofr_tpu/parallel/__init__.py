@@ -1,0 +1,8 @@
+from .mesh import create_mesh, mesh_axes
+from .sharding import llama_param_specs, shard_params, replicate
+from .train import TrainState, make_train_step, cross_entropy_loss
+
+__all__ = [
+    "create_mesh", "mesh_axes", "llama_param_specs", "shard_params",
+    "replicate", "TrainState", "make_train_step", "cross_entropy_loss",
+]

@@ -1,0 +1,99 @@
+"""Parameter sharding rules (GSPMD partition specs) per model family.
+
+Megatron-style tensor parallel layout for the Llama pytree: column-
+parallel up-projections (shard the output feature dim over ``tp``),
+row-parallel down-projections (shard the input feature dim), vocab-
+sharded embedding/head. The stacked layer axis (leading ``L``) shards
+over ``pp`` when the mesh has a pipeline axis — each stage holds a
+contiguous slice of layers, which is exactly what the GPipe runner in
+``pipeline.py`` consumes. XLA turns these annotations into
+all-gather / reduce-scatter on ICI; we never hand-write them.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _axis(mesh_axes: tuple, name: str) -> str | None:
+    return name if name in mesh_axes else None
+
+
+def llama_param_specs(mesh: Mesh) -> dict:
+    """PartitionSpec pytree matching llama_init's structure."""
+    ax = mesh.axis_names
+    tp = _axis(ax, "tp")
+    pp = _axis(ax, "pp")
+    specs = {
+        "embed": P(tp, None),                 # vocab-sharded
+        "layers": {
+            "attn_norm": P(pp, None),
+            "wq": P(pp, None, tp),            # column parallel
+            "wk": P(pp, None, tp),
+            "wv": P(pp, None, tp),
+            "wo": P(pp, tp, None),            # row parallel
+            "ffn_norm": P(pp, None),
+            "w1": P(pp, None, tp),
+            "w3": P(pp, None, tp),
+            "w2": P(pp, tp, None),
+        },
+        "final_norm": P(None),
+    }
+    specs["lm_head"] = P(None, tp)
+    return specs
+
+
+def moe_param_specs(mesh: Mesh) -> dict:
+    """MoE params: experts sharded over ``ep`` (falling back to ``tp``)."""
+    ax = mesh.axis_names
+    tp = _axis(ax, "tp")
+    pp = _axis(ax, "pp")
+    ep = _axis(ax, "ep") or tp
+    specs = {
+        "embed": P(tp, None),
+        "layers": {
+            "attn_norm": P(pp, None),
+            "wq": P(pp, None, tp),
+            "wk": P(pp, None, tp),
+            "wv": P(pp, None, tp),
+            "wo": P(pp, tp, None),
+            "ffn_norm": P(pp, None),
+            "gate": P(pp, None, None),
+            "w1": P(pp, ep, None, None),      # expert-sharded
+            "w3": P(pp, ep, None, None),
+            "w2": P(pp, ep, None, None),
+        },
+        "final_norm": P(None),
+    }
+    specs["lm_head"] = P(None, tp)
+    return specs
+
+
+def _match_specs(params: Any, specs: Any) -> Any:
+    """Prune spec tree to the keys present in params (tied embeddings
+    drop lm_head)."""
+    if isinstance(params, dict):
+        return {k: _match_specs(v, specs[k]) for k, v in params.items()}
+    return specs
+
+
+def shard_params(params: Any, mesh: Mesh, specs: Any) -> Any:
+    """Place a param pytree onto the mesh per the spec tree."""
+    specs = _match_specs(params, specs)
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        params, specs)
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    return jax.tree.map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, P())), tree)
+
+
+def batch_spec(mesh: Mesh) -> P:
+    """Input batch: sharded over dp (and sequence over sp if present)."""
+    ax = mesh.axis_names
+    return P(_axis(ax, "dp"), _axis(ax, "sp"))

@@ -1,0 +1,5 @@
+from .message import Message
+from .inmemory import InMemoryBroker
+from .subscriber import SubscriptionManager
+
+__all__ = ["Message", "InMemoryBroker", "SubscriptionManager"]

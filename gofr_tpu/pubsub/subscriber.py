@@ -1,0 +1,57 @@
+"""Subscriber runtime: broker messages -> handler Contexts.
+
+Mirrors reference pkg/gofr/subscriber.go: an event loop per topic that
+polls the container's pub/sub client, wraps each message in a Context,
+runs the handler with panic recovery, commits on success, and backs
+off 2 seconds on broker errors (subscriber.go:27-107).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable
+
+from ..context import Context
+
+ERROR_BACKOFF_S = 2.0
+
+
+class SubscriptionManager:
+    def __init__(self, container) -> None:
+        self.container = container
+
+    async def start_subscriber(self, topic: str, handler: Callable,
+                               group: str = "default") -> None:
+        """Infinite consume loop for one topic (one asyncio task)."""
+        while True:
+            try:
+                await self.handle_one(topic, handler, group)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:
+                self.container.logger.error(
+                    f"subscriber {topic!r}: {exc!r}; retrying in "
+                    f"{ERROR_BACKOFF_S}s")
+                await asyncio.sleep(ERROR_BACKOFF_S)
+
+    async def handle_one(self, topic: str, handler: Callable,
+                         group: str = "default") -> None:
+        """Consume and handle exactly one message (test-friendly)."""
+        pubsub = self.container.pubsub
+        if pubsub is None:
+            raise RuntimeError("no pub/sub client configured")
+        msg = await pubsub.subscribe(topic, group)
+        ctx = Context(request=msg, container=self.container)
+        metrics = self.container.metrics
+        try:
+            result = handler(ctx)
+            if hasattr(result, "__await__"):
+                await result
+        except Exception as exc:  # handler panic: log, do NOT commit
+            self.container.logger.error(
+                f"handler for {topic!r} failed: {exc!r}")
+            return
+        msg.commit()  # at-least-once: commit only on success
+        if metrics is not None:
+            metrics.increment_counter(
+                "app_pubsub_subscribe_success_count", topic=topic)

@@ -1,0 +1,23 @@
+from .client import (
+    APIKeyAuth,
+    BasicAuth,
+    CircuitBreaker,
+    CircuitOpenError,
+    CustomHeaders,
+    HealthConfig,
+    HTTPService,
+    OAuth2ClientCredentials,
+    RateLimit,
+    RateLimitedError,
+    Response,
+    Retry,
+    ServiceError,
+    new_http_service,
+)
+
+__all__ = [
+    "APIKeyAuth", "BasicAuth", "CircuitBreaker", "CircuitOpenError",
+    "CustomHeaders", "HealthConfig", "HTTPService",
+    "OAuth2ClientCredentials", "RateLimit", "RateLimitedError", "Response",
+    "Retry", "ServiceError", "new_http_service",
+]

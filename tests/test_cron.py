@@ -1,0 +1,78 @@
+"""Cron schedule parsing + scheduler loop tests."""
+
+import asyncio
+import time
+
+import pytest
+
+from gofr_tpu.container.mock import MockContainer
+from gofr_tpu.cron import Cron, CronParseError, Schedule
+
+
+def t(sec=0, minute=0, hour=0, day=1, month=1, weekday_py=0):
+    # build struct_time-like via time.struct_time
+    return time.struct_time((2026, month, day, hour, minute, sec, weekday_py, 1, -1))
+
+
+def test_parse_five_field_wildcard():
+    s = Schedule.parse("* * * * *")
+    assert s.matches(t(sec=0, minute=30, hour=12))
+    assert not s.matches(t(sec=5, minute=30))  # seconds default to 0
+
+
+def test_parse_six_field_seconds():
+    s = Schedule.parse("*/15 * * * * *")
+    assert s.matches(t(sec=0)) and s.matches(t(sec=45))
+    assert not s.matches(t(sec=7))
+
+
+def test_parse_ranges_lists_steps():
+    s = Schedule.parse("0-10/5 9,17 * * *")
+    assert s.matches(t(minute=0, hour=9))
+    assert s.matches(t(minute=5, hour=17))
+    assert s.matches(t(minute=10, hour=9))
+    assert not s.matches(t(minute=3, hour=9))
+    assert not s.matches(t(minute=0, hour=12))
+
+
+def test_weekday_convention():
+    # cron 0 = Sunday; python tm_wday 6 = Sunday
+    s = Schedule.parse("0 0 * * 0")
+    assert s.matches(t(weekday_py=6))
+    assert not s.matches(t(weekday_py=0))  # Monday
+
+
+def test_parse_errors():
+    with pytest.raises(CronParseError):
+        Schedule.parse("* * *")
+    with pytest.raises(CronParseError):
+        Schedule.parse("61 * * * *")
+    with pytest.raises(CronParseError):
+        Schedule.parse("a * * * *")
+    with pytest.raises(CronParseError):
+        Schedule.parse("*/0 * * * *")
+
+
+def test_cron_fires_matching_jobs():
+    container = MockContainer()
+    cron = Cron(container)
+    fired = []
+    cron.add("* * * * * *", "tick", lambda ctx: fired.append(time.time()))
+    failing = []
+
+    def bad(ctx):
+        failing.append(1)
+        raise RuntimeError("job blew up")
+    cron.add("* * * * * *", "bad", bad)
+
+    async def run():
+        task = asyncio.ensure_future(cron.run())
+        await asyncio.sleep(2.3)
+        task.cancel()
+
+    asyncio.run(run())
+    assert len(fired) >= 2  # every-second job fired each tick
+    assert len(failing) >= 2
+    # panic recovery logged, loop survived
+    assert any("bad" in str(l.get("message", ""))
+               for l in container.log_lines)

@@ -1,0 +1,157 @@
+"""Pub/sub broker + subscriber runtime tests."""
+
+import asyncio
+
+from gofr_tpu.container.mock import MockContainer
+from gofr_tpu.pubsub.inmemory import InMemoryBroker, partition_for
+from gofr_tpu.pubsub.subscriber import SubscriptionManager
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_publish_subscribe_roundtrip():
+    async def flow():
+        broker = InMemoryBroker()
+        await broker.publish("orders", {"id": 1, "amount": 9.5})
+        msg = await broker.subscribe("orders")
+        assert msg.topic == "orders"
+        assert msg.bind() == {"id": 1, "amount": 9.5}
+        msg.commit()
+        assert msg.committed
+    run(flow())
+
+
+def test_consumer_groups_each_get_copy():
+    async def flow():
+        broker = InMemoryBroker()
+        broker.create_topic("t")
+        # pre-register both groups by subscribing concurrently
+        async def consume(group):
+            return await broker.subscribe("t", group)
+        t1 = asyncio.ensure_future(consume("g1"))
+        t2 = asyncio.ensure_future(consume("g2"))
+        await asyncio.sleep(0.01)
+        await broker.publish("t", b"payload")
+        m1, m2 = await asyncio.gather(t1, t2)
+        assert m1.value == m2.value == b"payload"
+    run(flow())
+
+
+def test_uncommitted_redelivery():
+    async def flow():
+        broker = InMemoryBroker()
+        await broker.publish("jobs", b"work-1")
+        msg = await broker.subscribe("jobs")
+        assert not msg.committed
+        # simulate crash: never commit; requeue pending
+        n = broker.redeliver_uncommitted("jobs")
+        assert n == 1
+        again = await broker.subscribe("jobs")
+        assert again.value == b"work-1"
+        again.commit()
+        assert broker.redeliver_uncommitted("jobs") == 0
+    run(flow())
+
+
+def test_subscriber_runtime_commit_on_success_only():
+    async def flow():
+        container = MockContainer()
+        broker = InMemoryBroker(metrics=container.metrics)
+        container.pubsub = broker
+        manager = SubscriptionManager(container)
+
+        seen = []
+        calls = {"n": 0}
+
+        def handler(ctx):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("first attempt fails")
+            seen.append(ctx.bind())
+
+        await broker.publish("audio", {"file": "a.wav"})
+        await manager.handle_one("audio", handler)      # fails -> no commit
+        assert broker.redeliver_uncommitted("audio") == 1
+        await manager.handle_one("audio", handler)      # succeeds -> commit
+        assert seen == [{"file": "a.wav"}]
+        assert broker.redeliver_uncommitted("audio") == 0
+        # metrics counted both deliveries, one success
+        total = container.metrics.get("app_pubsub_subscribe_total_count")
+        success = container.metrics.get("app_pubsub_subscribe_success_count")
+        assert total.get(topic="audio") == 2
+        assert success.get(topic="audio") == 1
+    run(flow())
+
+
+def test_message_implements_request_protocol():
+    async def flow():
+        broker = InMemoryBroker()
+        await broker.publish("t", b"\x00binary", key="k1",
+                             metadata={"source": "cam-1"})
+        msg = await broker.subscribe("t")
+        assert msg.param("source") == "cam-1"
+        assert msg.path_param("topic") == "t"
+        assert msg.host_name() == "t"
+        assert msg.bind() == b"\x00binary"  # non-json stays raw
+    run(flow())
+
+
+def test_partition_for_stable_and_bounded():
+    parts = {partition_for(f"key-{i}", 8) for i in range(100)}
+    assert parts <= set(range(8))
+    assert len(parts) > 3  # spreads
+    assert partition_for("abc", 8) == partition_for("abc", 8)
+    assert partition_for("x", 1) == 0
+
+
+def test_app_level_subscription():
+    """app.subscribe drives handlers from broker messages end-to-end."""
+    from gofr_tpu.app import App
+    from gofr_tpu.config import DictConfig
+    import threading
+    import time as time_mod
+
+    app = App(config=DictConfig({"HTTP_PORT": "0", "METRICS_PORT": "0"}))
+    broker = InMemoryBroker()
+    app.container.pubsub = broker
+    received = []
+
+    @app.subscribe("events")
+    def on_event(ctx):
+        received.append(ctx.bind())
+
+    stop = {}
+
+    def runner():
+        async def main():
+            await app.start()
+            await broker.publish("events", {"n": 1})
+            await broker.publish("events", {"n": 2})
+            for _ in range(100):
+                if len(received) >= 2:
+                    break
+                await asyncio.sleep(0.02)
+            await app.stop()
+        asyncio.run(main())
+
+    t = threading.Thread(target=runner)
+    t.start()
+    t.join(20)
+    assert received == [{"n": 1}, {"n": 2}]
+
+
+def test_backlog_replayed_to_late_group():
+    async def flow():
+        broker = InMemoryBroker()
+        await broker.publish("t", b"m1")   # nobody listening yet
+        await broker.publish("t", b"m2")
+        msg = await broker.subscribe("t", "late-group")
+        assert msg.value == b"m1"
+        msg2 = await broker.subscribe("t", "late-group")
+        assert msg2.value == b"m2"
+        # a second late group also sees the retained messages
+        other = await broker.subscribe("t", "other-group")
+        assert other.value == b"m1"
+    run(flow())

@@ -1,0 +1,183 @@
+"""Inter-service HTTP client tests against a real in-process server."""
+
+import asyncio
+import base64
+import json
+
+import pytest
+
+from gofr_tpu.http import ErrorServiceUnavailable  # noqa: F401  (import check)
+from gofr_tpu.service.client import (
+    APIKeyAuth,
+    BasicAuth,
+    CircuitBreaker,
+    CircuitOpenError,
+    CustomHeaders,
+    HTTPService,
+    RateLimit,
+    RateLimitedError,
+    Retry,
+    ServiceError,
+)
+
+from .apputil import AppRunner
+
+
+def build_upstream(app):
+    state = {"fail_next": 0, "hits": 0}
+    app._test_state = state
+
+    @app.get("/ok")
+    def ok(ctx):
+        return {"msg": "hi", "auth": ctx.header("Authorization"),
+                "apikey": ctx.header("X-Api-Key"),
+                "custom": ctx.header("X-Custom"),
+                "traceparent": ctx.header("traceparent")}
+
+    @app.get("/flaky")
+    def flaky(ctx):
+        state["hits"] += 1
+        if state["fail_next"] > 0:
+            state["fail_next"] -= 1
+            raise RuntimeError("boom")
+        return {"hits": state["hits"]}
+
+    @app.post("/echo")
+    def echo(ctx):
+        return ctx.bind()
+
+
+@pytest.fixture(scope="module")
+def upstream():
+    with AppRunner(build=build_upstream) as app:
+        yield app
+
+
+def call(service, method="get", path="/ok", **kw):
+    return asyncio.run(getattr(service, method)(path, **kw))
+
+
+def test_basic_request_and_json(upstream):
+    svc = HTTPService(f"http://127.0.0.1:{upstream.port}")
+    resp = call(svc)
+    assert resp.ok and resp.json()["data"]["msg"] == "hi"
+
+
+def test_post_json_body(upstream):
+    svc = HTTPService(f"http://127.0.0.1:{upstream.port}")
+    resp = call(svc, "post", "/echo", json={"a": [1, 2]})
+    assert resp.status == 201
+    assert resp.json()["data"] == {"a": [1, 2]}
+
+
+def test_query_params(upstream):
+    svc = HTTPService(f"http://127.0.0.1:{upstream.port}")
+    resp = call(svc, "get", "/ok", params={"x": "1 2"})
+    assert resp.ok
+
+
+def test_auth_options(upstream):
+    svc = HTTPService(f"http://127.0.0.1:{upstream.port}",
+                      BasicAuth("user", "pass"),
+                      APIKeyAuth("secret-key"),
+                      CustomHeaders({"X-Custom": "v"}))
+    data = call(svc).json()["data"]
+    expected = base64.b64encode(b"user:pass").decode()
+    assert data["auth"] == f"Basic {expected}"
+    assert data["apikey"] == "secret-key"
+    assert data["custom"] == "v"
+
+
+def test_trace_propagation(upstream):
+    from gofr_tpu.tracing import InMemoryExporter, Tracer
+    tracer = Tracer(exporter=InMemoryExporter())
+
+    async def flow():
+        svc = HTTPService(f"http://127.0.0.1:{upstream.port}", tracer=tracer)
+        with tracer.start_span("client-op") as span:
+            resp = await svc.get("/ok")
+            return span.trace_id, resp.json()["data"]["traceparent"]
+
+    trace_id, header = asyncio.run(flow())
+    assert trace_id in header
+
+
+def test_retry_recovers_from_5xx(upstream):
+    upstream.app._test_state["fail_next"] = 2
+    svc = HTTPService(f"http://127.0.0.1:{upstream.port}",
+                      Retry(max_retries=3, backoff_s=0.01))
+    resp = call(svc, "get", "/flaky")
+    assert resp.ok
+
+
+def test_retry_gives_up_on_connection_refused():
+    svc = HTTPService("http://127.0.0.1:1", Retry(max_retries=1, backoff_s=0.01),
+                      timeout=0.5)
+    with pytest.raises(ServiceError, match="attempts"):
+        call(svc)
+
+
+def test_rate_limit(upstream):
+    svc = HTTPService(f"http://127.0.0.1:{upstream.port}",
+                      RateLimit(rate=0.001, burst=2))
+    assert call(svc).ok
+    assert call(svc).ok
+    with pytest.raises(RateLimitedError):
+        call(svc)
+
+
+def test_circuit_breaker_opens_and_recovers(upstream):
+    cb = CircuitBreaker(threshold=2, interval_s=0.05)
+    svc = HTTPService(f"http://127.0.0.1:{upstream.port}", cb)
+
+    async def flow():
+        upstream.app._test_state["fail_next"] = 10
+        for _ in range(2):
+            resp = await svc.get("/flaky")
+            assert resp.status == 500
+        assert cb.is_open
+        with pytest.raises(CircuitOpenError):
+            await svc.get("/flaky")
+        # upstream recovers; health probe closes the breaker
+        upstream.app._test_state["fail_next"] = 0
+        for _ in range(40):
+            if not cb.is_open:
+                break
+            await asyncio.sleep(0.05)
+        assert not cb.is_open
+        resp = await svc.get("/flaky")
+        assert resp.ok
+
+    asyncio.run(flow())
+
+
+def test_health_check(upstream):
+    svc = HTTPService(f"http://127.0.0.1:{upstream.port}")
+    assert asyncio.run(svc.health_check()) == {"status": "UP"}
+    dead = HTTPService("http://127.0.0.1:1", timeout=0.5)
+    assert asyncio.run(dead.health_check())["status"] == "DOWN"
+
+
+def test_container_service_registration(upstream):
+    from gofr_tpu.container.container import Container
+    c = Container()
+    c.register_service("billing",
+                       HTTPService(f"http://127.0.0.1:{upstream.port}"))
+    health = c.health()
+    assert health["checks"]["service:billing"]["status"] == "UP"
+
+
+def test_circuit_breaker_lazy_half_open_across_loops(upstream):
+    """Short-lived loops (asyncio.run per call) must not strand the
+    circuit open: one trial request per interval passes half-open."""
+    cb = CircuitBreaker(threshold=2, interval_s=0.05)
+    svc = HTTPService(f"http://127.0.0.1:{upstream.port}", cb)
+    upstream.app._test_state["fail_next"] = 10
+    for _ in range(2):
+        assert call(svc, "get", "/flaky").status == 500  # separate loops
+    assert cb.is_open
+    upstream.app._test_state["fail_next"] = 0
+    import time as time_mod
+    time_mod.sleep(0.06)
+    resp = call(svc, "get", "/flaky")  # half-open trial, new loop
+    assert resp.ok and not cb.is_open
