@@ -23,6 +23,11 @@ STATUS_UP = "UP"
 STATUS_DOWN = "DOWN"
 STATUS_DEGRADED = "DEGRADED"
 
+# 50µs–30s, the reference's datasource latency buckets
+# (container/container.go:339-344)
+_DATASOURCE_BUCKETS = (0.00005, 0.0001, 0.0005, 0.001, 0.005, 0.01,
+                       0.05, 0.1, 0.5, 1, 5, 30)
+
 
 class Container:
     def __init__(self, config=None, logger: Logger | None = None) -> None:
@@ -35,6 +40,7 @@ class Container:
         self.services: dict[str, Any] = {}   # name -> service.HTTPService
         self.pubsub: Any = None              # pubsub client
         self.sql: Any = None                 # SQL datasource
+        self.redis: Any = None               # redis-shaped store
         self.kv: Any = None                  # key-value store
         self.file: Any = None                # file store
         self.ws_manager: Any = None          # websocket connection manager
@@ -63,8 +69,12 @@ class Container:
             exporter = InMemoryExporter()
         c.tracer = Tracer(service_name=c.app_name, exporter=exporter, ratio=ratio)
 
-        # Datasources connect lazily via add_* (reference external_db.go);
-        # env-driven defaults mirror container.go:128-174.
+        # Env-driven datasources (reference container.go:128-174); anything
+        # not configured stays None and can be attached later via add_*.
+        from ..datasource.redis import new_redis
+        from ..datasource.sql import new_sql
+        c.sql = new_sql(config, logger, c.metrics, c.tracer)
+        c.redis = new_redis(config, logger, c.metrics, c.tracer)
         return c
 
     # ------------------------------------------------- framework metrics
@@ -78,11 +88,13 @@ class Container:
         m.new_histogram("app_http_service_response",
                         "outbound http client response time in seconds")
         m.new_histogram("app_sql_stats", "sql query time in seconds",
-                        buckets=(0.00005, 0.0001, 0.0005, 0.001, 0.005, 0.01,
-                                 0.05, 0.1, 0.5, 1, 5, 30))
+                        buckets=_DATASOURCE_BUCKETS)
         m.new_histogram("app_kv_stats", "kv op time in seconds",
-                        buckets=(0.00005, 0.0001, 0.0005, 0.001, 0.005, 0.01,
-                                 0.05, 0.1, 0.5, 1, 5, 30))
+                        buckets=_DATASOURCE_BUCKETS)
+        m.new_histogram("app_redis_stats", "redis op time in seconds",
+                        buckets=_DATASOURCE_BUCKETS)
+        m.new_histogram("app_file_stats", "file op time in seconds",
+                        buckets=_DATASOURCE_BUCKETS)
         m.new_histogram("app_pubsub_publish_latency", "publish time in seconds")
         m.new_counter("app_pubsub_publish_total_count", "messages published")
         m.new_counter("app_pubsub_publish_success_count", "publishes succeeded")
@@ -109,7 +121,7 @@ class Container:
         }
         statuses: list[str] = []
         checks: dict[str, Any] = {}
-        for name in ("sql", "kv", "file", "pubsub", "tpu"):
+        for name in ("sql", "redis", "kv", "file", "pubsub", "tpu"):
             source = getattr(self, name)
             if source is None:
                 continue
@@ -140,8 +152,13 @@ class Container:
                     result = asyncio.run(result)
                 else:
                     import concurrent.futures
-                    with concurrent.futures.ThreadPoolExecutor(1) as pool:
+                    # no `with`: shutdown(wait=True) would join a hung
+                    # check and defeat the 10s bound
+                    pool = concurrent.futures.ThreadPoolExecutor(1)
+                    try:
                         result = pool.submit(asyncio.run, result).result(10)
+                    finally:
+                        pool.shutdown(wait=False)
             if isinstance(result, dict):
                 return result
             return {"status": STATUS_UP if result else STATUS_DOWN}
@@ -149,6 +166,40 @@ class Container:
             return {"status": STATUS_DOWN, "error": str(exc)}
 
     # ------------------------------------------------------ registration
+    def _provide(self, store: Any) -> Any:
+        """use_logger → use_metrics → use_tracer → connect → return,
+        the provider wiring order of reference external_db.go."""
+        for setter, dep in (("use_logger", self.logger),
+                            ("use_metrics", self.metrics),
+                            ("use_tracer", self.tracer)):
+            fn = getattr(store, setter, None)
+            if fn is not None:
+                fn(dep)
+        connect = getattr(store, "connect", None)
+        if connect is not None:
+            connect()
+        return store
+
+    def add_sql(self, store: Any) -> Any:
+        self.sql = self._provide(store)
+        return self.sql
+
+    def add_redis(self, store: Any) -> Any:
+        self.redis = self._provide(store)
+        return self.redis
+
+    def add_kv_store(self, store: Any) -> Any:
+        self.kv = self._provide(store)
+        return self.kv
+
+    def add_file_store(self, store: Any) -> Any:
+        self.file = self._provide(store)
+        return self.file
+
+    def add_pubsub(self, client: Any) -> Any:
+        self.pubsub = self._provide(client)
+        return self.pubsub
+
     def register_service(self, name: str, service: Any) -> None:
         self.services[name] = service
 
@@ -162,7 +213,7 @@ class Container:
         return self.models.get(name)
 
     async def close(self) -> None:
-        for attr in ("sql", "kv", "file", "pubsub", "tpu"):
+        for attr in ("sql", "redis", "kv", "file", "pubsub", "tpu"):
             source = getattr(self, attr)
             closer = getattr(source, "close", None)
             if closer is None:

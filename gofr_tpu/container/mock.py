@@ -59,6 +59,15 @@ class MockContainer(Container):
         self.trace_exporter = InMemoryExporter()
         self.tracer = Tracer(service_name="mock-app", exporter=self.trace_exporter)
         self.mocks: dict[str, CallRecorder] = {}
+        # real in-memory backends by default (sqlite SQL, dict KV,
+        # in-process redis) so handler tests exercise actual query paths;
+        # mock(slot) swaps any of them for a CallRecorder
+        from ..datasource.kv import InMemoryKV
+        from ..datasource.redis import Redis
+        from ..datasource.sql import SQL
+        self.add_sql(SQL(database=":memory:"))
+        self.add_redis(Redis())
+        self.add_kv_store(InMemoryKV())
 
     def mock(self, slot: str) -> CallRecorder:
         """Install a CallRecorder at a container slot and return it."""

@@ -60,6 +60,10 @@ class Context:
         return self.container.sql
 
     @property
+    def redis(self):
+        return self.container.redis
+
+    @property
     def kv(self):
         return self.container.kv
 

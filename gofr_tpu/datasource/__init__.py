@@ -1,0 +1,57 @@
+"""Datasource layer: instrumented stores behind narrow interfaces.
+
+Mirrors the reference's datasource tree (pkg/gofr/datasource/*): every
+store follows the provider pattern — ``use_logger`` / ``use_metrics`` /
+``use_tracer`` then ``connect`` (reference container/datasources.go:346-358)
+— and exposes ``health_check`` for the container's aggregate health
+(container/health.go:8-98).
+
+Shipped backends:
+- :mod:`.sql` — sqlite-backed SQL with dialect-aware placeholders,
+  query logging, metrics, ORM-lite ``select``.
+- :mod:`.redis` — Redis-shaped KV with an in-process backend (the
+  miniredis analog SURVEY §4 prescribes for hermetic tests).
+- :mod:`.kv` — minimal key-value store interface (badger analog) with
+  in-memory and sqlite-file backends.
+- :mod:`.file_store` — FileSystem abstraction over the local FS with
+  JSON/CSV row readers.
+- :mod:`.dbresolver` — SQL primary/replica router with per-replica
+  circuit breakers.
+"""
+
+from typing import Any, Protocol
+
+
+class HealthChecker(Protocol):
+    """reference container/datasources.go:360-364."""
+
+    def health_check(self) -> dict[str, Any]: ...
+
+
+class Provider(Protocol):
+    """reference container/datasources.go:346-358."""
+
+    def use_logger(self, logger: Any) -> None: ...
+
+    def use_metrics(self, metrics: Any) -> None: ...
+
+    def use_tracer(self, tracer: Any) -> None: ...
+
+    def connect(self) -> None: ...
+
+
+class ProviderMixin:
+    """The use_logger/use_metrics/use_tracer wiring every store shares."""
+
+    logger: Any = None
+    metrics: Any = None
+    tracer: Any = None
+
+    def use_logger(self, logger: Any) -> None:
+        self.logger = logger
+
+    def use_metrics(self, metrics: Any) -> None:
+        self.metrics = metrics
+
+    def use_tracer(self, tracer: Any) -> None:
+        self.tracer = tracer

@@ -207,6 +207,10 @@ class Manager:
     def get(self, name: str) -> _Metric | None:
         return self._metrics.get(name)
 
+    def get_histogram_count(self, name: str, **labels: str) -> int:
+        m = self._lookup(name, Histogram)
+        return 0 if m is None else m.get_count(**labels)
+
     # -- scrape
     def render_prometheus(self) -> str:
         with self._lock:

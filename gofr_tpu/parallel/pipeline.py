@@ -21,32 +21,22 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.llama import LlamaConfig
-from ..ops.attention import xla_attention
-from ..ops.norms import rms_norm
-from ..ops.rope import apply_rope, rope_frequencies
+from ..models.llama import LlamaConfig, _attn_block, _logits, _mlp_block
+from ..ops.rope import rope_frequencies
 from .mesh import mesh_axes
 from .train import TrainState, cross_entropy_loss, default_optimizer
 
 
 def _stage_forward(x, layers_local, c: LlamaConfig, inv_freq, positions):
-    """Run this stage's slice of layers over activations x [mb, S, D]."""
-    b, s, _ = x.shape
-    hd = c.head_dim
+    """Run this stage's slice of layers over activations x [mb, S, D].
+
+    Reuses the dense path's block math (models/llama.py) so pipeline
+    stages can never drift from single-chip semantics."""
 
     def layer_fn(x, lp):
-        h = rms_norm(x, lp["attn_norm"], c.norm_eps)
-        q = (h @ lp["wq"]).reshape(b, s, c.n_heads, hd)
-        k = (h @ lp["wk"]).reshape(b, s, c.n_kv_heads, hd)
-        v = (h @ lp["wv"]).reshape(b, s, c.n_kv_heads, hd)
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
-        out = xla_attention(q, k, v, causal=True)
-        x = x + (out.reshape(b, s, c.n_heads * hd) @ lp["wo"])
-        h2 = rms_norm(x, lp["ffn_norm"], c.norm_eps)
-        mlp = (jax.nn.silu((h2 @ lp["w1"]).astype(jnp.float32))
-               * (h2 @ lp["w3"]).astype(jnp.float32)).astype(x.dtype) @ lp["w2"]
-        return x + mlp, None
+        out, _k, _v = _attn_block(x, lp, c, inv_freq, positions, None, "xla")
+        x = x + out
+        return x + _mlp_block(x, lp, c), None
 
     x, _ = jax.lax.scan(layer_fn, x, layers_local)
     return x
@@ -84,10 +74,7 @@ def make_pipeline_train_step(config: LlamaConfig, mesh: Mesh, *,
             return params["embed"][tok]
 
         def head_loss(x, tgt, msk):
-            x = rms_norm(x, params["final_norm"], c.norm_eps)
-            head = (params["embed"].T if c.tie_embeddings
-                    else params["lm_head"])
-            logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
+            logits = _logits(params, c, x)
             nll = -jnp.take_along_axis(
                 jax.nn.log_softmax(logits, axis=-1), tgt[..., None],
                 axis=-1)[..., 0]

@@ -33,9 +33,6 @@ class TrainState:
     opt_state: Any
     step: jnp.ndarray
 
-    def tree_flatten(self):  # pragma: no cover - registered below
-        return (self.params, self.opt_state, self.step), None
-
 
 jax.tree_util.register_pytree_node(
     TrainState,

@@ -48,6 +48,9 @@ class InMemoryBroker:
 
     def delete_topic(self, name: str) -> None:
         self._topics.pop(name, None)
+        # drop the retained backlog too, or a recreated topic replays
+        # pre-delete messages and deleted topics leak their cap forever
+        self._backlog.pop(name, None)
 
     @property
     def topics(self) -> list[str]:

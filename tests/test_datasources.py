@@ -1,0 +1,320 @@
+"""Datasource layer: SQL, Redis, KV, file store, container wiring.
+
+Mirrors the reference's hermetic-fake test strategy (SURVEY §4):
+sqlite-in-memory for SQL (go-sqlmock analog), the in-process Redis
+(miniredis analog), tmp dirs for the file store.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import pytest
+
+from gofr_tpu.config.env import DictConfig
+from gofr_tpu.container.container import Container
+from gofr_tpu.container.mock import MockContainer
+from gofr_tpu.datasource.file_store import FileError, LocalFileSystem
+from gofr_tpu.datasource.kv import FileKV, InMemoryKV, KeyNotFound
+from gofr_tpu.datasource.redis import Redis, RedisError
+from gofr_tpu.datasource.sql import (SQL, SQLError, placeholder,
+                                     placeholders, quote_ident)
+from gofr_tpu.logging.logger import MockLogger
+from gofr_tpu.metrics.registry import Manager
+
+
+@dataclass
+class Employee:
+    id: int
+    name: str
+    salary: float
+
+
+class TestSQL:
+    def make(self) -> SQL:
+        db = SQL(database=":memory:")
+        db.use_logger(MockLogger())
+        m = Manager()
+        m.new_histogram("app_sql_stats", "t")
+        db.use_metrics(m)
+        db.connect()
+        return db
+
+    def test_query_exec_roundtrip(self):
+        db = self.make()
+        db.exec("CREATE TABLE t (id INTEGER PRIMARY KEY, name TEXT)")
+        db.exec("INSERT INTO t (name) VALUES (?)", "ada")
+        rows = db.query("SELECT * FROM t")
+        assert rows[0]["name"] == "ada"
+        assert db.query_row("SELECT * FROM t WHERE id = ?", 1)["name"] == "ada"
+        assert db.query_row("SELECT * FROM t WHERE id = ?", 99) is None
+
+    def test_select_maps_dataclass(self):
+        db = self.make()
+        db.exec("CREATE TABLE employee (id INTEGER PRIMARY KEY, "
+                "name TEXT, salary REAL)")
+        db.exec("INSERT INTO employee (name, salary) VALUES (?, ?)",
+                "grace", 120.5)
+        out = db.select(Employee, "SELECT * FROM employee")
+        assert out == [Employee(id=1, name="grace", salary=120.5)]
+
+    def test_select_requires_dataclass(self):
+        db = self.make()
+        with pytest.raises(SQLError):
+            db.select(dict, "SELECT 1")
+
+    def test_transaction_commit_and_rollback(self):
+        db = self.make()
+        db.exec("CREATE TABLE t (id INTEGER PRIMARY KEY, v TEXT)")
+        with db.begin() as tx:
+            tx.exec("INSERT INTO t (v) VALUES (?)", "kept")
+        with pytest.raises(RuntimeError):
+            with db.begin() as tx:
+                tx.exec("INSERT INTO t (v) VALUES (?)", "dropped")
+                raise RuntimeError("boom")
+        values = [r["v"] for r in db.query("SELECT v FROM t")]
+        assert values == ["kept"]
+
+    def test_metrics_and_logs_recorded(self):
+        db = self.make()
+        db.exec("CREATE TABLE t (id INTEGER)")
+        db.query("SELECT * FROM t")
+        assert db.metrics.get_histogram_count("app_sql_stats",
+                                              type="select") == 1
+        assert any("SQL" in str(line.get("message", ""))
+                   for line in db.logger.lines)
+
+    def test_unconnected_raises(self):
+        with pytest.raises(SQLError):
+            SQL().query("SELECT 1")
+
+    def test_unsupported_dialect_connect(self):
+        db = SQL(dialect="mysql")
+        with pytest.raises(SQLError):
+            db.connect()
+
+    def test_unknown_dialect_rejected(self):
+        with pytest.raises(SQLError):
+            SQL(dialect="oracle")
+
+    def test_health(self):
+        db = self.make()
+        assert db.health_check()["status"] == "UP"
+        db.close()
+        assert db.health_check()["status"] == "DOWN"
+
+    def test_placeholder_styles(self):
+        assert placeholder("sqlite", 1) == "?"
+        assert placeholder("mysql", 2) == "?"
+        assert placeholder("postgres", 2) == "$2"
+        assert placeholders("postgres", 3) == "$1, $2, $3"
+        assert placeholders("sqlite", 2) == "?, ?"
+
+    def test_quote_ident_rejects_injection(self):
+        assert quote_ident("salary") == "salary"
+        with pytest.raises(SQLError):
+            quote_ident("salary; DROP TABLE t")
+
+
+class TestRedis:
+    def make(self) -> Redis:
+        r = Redis()
+        m = Manager()
+        m.new_histogram("app_redis_stats", "t")
+        r.use_metrics(m)
+        r.connect()
+        return r
+
+    def test_string_ops(self):
+        r = self.make()
+        assert r.set("k", "v")
+        assert r.get("k") == "v"
+        assert r.exists("k") == 1
+        assert r.delete("k") == 1
+        assert r.get("k") is None
+
+    def test_expiry(self):
+        r = self.make()
+        r.set("k", "v", ex=0.02)
+        assert r.get("k") == "v"
+        assert 0 < r.ttl("k") <= 0.02
+        time.sleep(0.03)
+        assert r.get("k") is None
+        assert r.ttl("k") == -2
+        r.set("forever", 1)
+        assert r.ttl("forever") == -1
+
+    def test_incr_decr(self):
+        r = self.make()
+        assert r.incr("n") == 1
+        assert r.incr("n", 5) == 6
+        assert r.decr("n") == 5
+
+    def test_hash_list_set_ops(self):
+        r = self.make()
+        assert r.hset("h", "f", "1") == 1
+        assert r.hset("h", "f", "2") == 0
+        assert r.hget("h", "f") == "2"
+        assert r.hgetall("h") == {"f": "2"}
+        assert r.hdel("h", "f") == 1
+
+        r.rpush("l", "a", "b")
+        r.lpush("l", "z")
+        assert r.lrange("l", 0, -1) == ["z", "a", "b"]
+        assert r.llen("l") == 3
+        assert r.lpop("l") == "z"
+        assert r.rpop("l") == "b"
+
+        assert r.sadd("s", "x", "y") == 2
+        assert r.sismember("s", "x")
+        assert r.smembers("s") == {"x", "y"}
+        assert r.srem("s", "x") == 1
+
+    def test_wrongtype(self):
+        r = self.make()
+        r.set("k", "str")
+        with pytest.raises(RedisError):
+            r.hset("k", "f", "v")
+
+    def test_keys_and_flush(self):
+        r = self.make()
+        r.set("user:1", "a")
+        r.set("user:2", "b")
+        r.set("other", "c")
+        assert sorted(r.keys("user:*")) == ["user:1", "user:2"]
+        r.flushdb()
+        assert r.keys() == []
+
+    def test_not_connected(self):
+        with pytest.raises(RedisError):
+            Redis().get("k")
+
+    def test_health_and_metrics(self):
+        r = self.make()
+        r.set("k", "v")
+        assert r.health_check()["status"] == "UP"
+        assert r.metrics.get_histogram_count("app_redis_stats",
+                                             type="set") == 1
+
+
+class TestKV:
+    @pytest.mark.parametrize("make", [
+        lambda tmp: InMemoryKV(),
+        lambda tmp: FileKV(str(tmp / "kv.db")),
+    ], ids=["memory", "file"])
+    def test_roundtrip(self, make, tmp_path):
+        kv = make(tmp_path)
+        kv.connect()
+        kv.set("a", "1")
+        kv.set("b", "2")
+        kv.set("a", "3")
+        assert kv.get("a") == "3"
+        assert kv.keys() == ["a", "b"]
+        kv.delete("a")
+        with pytest.raises(KeyNotFound):
+            kv.get("a")
+        assert kv.health_check()["status"] == "UP"
+        kv.close()
+
+    def test_file_kv_persists(self, tmp_path):
+        path = str(tmp_path / "kv.db")
+        kv = FileKV(path)
+        kv.connect()
+        kv.set("k", "v")
+        kv.close()
+        kv2 = FileKV(path)
+        kv2.connect()
+        assert kv2.get("k") == "v"
+
+
+class TestFileStore:
+    def make(self, tmp_path) -> LocalFileSystem:
+        fs = LocalFileSystem(str(tmp_path))
+        fs.connect()
+        return fs
+
+    def test_create_read_append_remove(self, tmp_path):
+        fs = self.make(tmp_path)
+        fs.create("a/b.txt", "hello")
+        assert fs.read_text("a/b.txt") == "hello"
+        fs.append("a/b.txt", " world")
+        assert fs.read_text("a/b.txt") == "hello world"
+        info = fs.stat("a/b.txt")
+        assert info.size == 11 and not info.is_dir
+        fs.rename("a/b.txt", "a/c.txt")
+        assert fs.exists("a/c.txt") and not fs.exists("a/b.txt")
+        fs.remove("a/c.txt")
+        assert not fs.exists("a/c.txt")
+
+    def test_dirs_and_glob(self, tmp_path):
+        fs = self.make(tmp_path)
+        fs.mkdir("sub/deep")
+        fs.create("sub/x.json", "{}")
+        fs.create("sub/y.csv", "a,b")
+        names = [i.name for i in fs.read_dir("sub")]
+        assert names == ["deep", "x.json", "y.csv"]
+        assert fs.glob("sub/*.json") == ["sub/x.json"]
+        fs.remove_all("sub")
+        assert not fs.exists("sub")
+
+    def test_path_escape_blocked(self, tmp_path):
+        fs = self.make(tmp_path)
+        with pytest.raises(FileError):
+            fs.read("../outside.txt")
+
+    def test_row_readers(self, tmp_path):
+        fs = self.make(tmp_path)
+        fs.create("rows.json", '[{"a": 1}, {"a": 2}]')
+        assert [r["a"] for r in fs.read_rows("rows.json")] == [1, 2]
+        fs.create("rows.jsonl", '{"a": 1}\n{"a": 2}\n')
+        assert len(fs.read_rows("rows.jsonl")) == 2
+        fs.create("rows.csv", "a,b\n1,2\n3,4\n")
+        rows = list(fs.read_rows("rows.csv"))
+        assert rows[1] == {"a": "3", "b": "4"}
+        fs.create("rows.txt", "plain")
+        with pytest.raises(FileError):
+            fs.read_rows("rows.txt", kind="txt")
+
+    def test_health(self, tmp_path):
+        fs = self.make(tmp_path)
+        assert fs.health_check()["status"] == "UP"
+
+
+class TestContainerWiring:
+    def test_env_driven_creation(self):
+        config = DictConfig({"DB_DIALECT": "sqlite", "DB_NAME": ":memory:",
+                             "REDIS_HOST": "localhost"})
+        c = Container.create(config)
+        assert c.sql is not None and c.redis is not None
+        c.sql.exec("CREATE TABLE t (id INTEGER)")
+        c.redis.set("k", "v")
+        health = c.health()
+        assert health["checks"]["sql"]["status"] == "UP"
+        assert health["checks"]["redis"]["status"] == "UP"
+
+    def test_unconfigured_stays_none(self):
+        c = Container.create(DictConfig())
+        assert c.sql is None and c.redis is None
+
+    def test_add_store_provider_order(self, tmp_path):
+        c = Container.create(DictConfig())
+        fs = c.add_file_store(LocalFileSystem(str(tmp_path)))
+        assert fs.logger is c.logger and fs.metrics is c.metrics
+        kv = c.add_kv_store(InMemoryKV())
+        assert kv.logger is c.logger
+        assert c.health()["checks"]["file"]["status"] == "UP"
+
+    def test_mock_container_has_real_backends(self):
+        mc = MockContainer()
+        mc.sql.exec("CREATE TABLE t (id INTEGER)")
+        mc.sql.exec("INSERT INTO t VALUES (1)")
+        assert mc.sql.query("SELECT * FROM t")[0]["id"] == 1
+        mc.redis.set("k", "v")
+        assert mc.redis.get("k") == "v"
+        mc.kv.set("a", "b")
+        assert mc.kv.get("a") == "b"
+        # mock() still swaps a slot for a recorder
+        rec = mc.mock("sql")
+        mc.sql.query("SELECT 1")
+        assert rec.calls_to("query") == [(("SELECT 1",), {})]

@@ -1,0 +1,117 @@
+"""DBResolver: read/write routing, breakers, failover, primary pinning."""
+
+from __future__ import annotations
+
+import pytest
+
+from gofr_tpu.datasource.dbresolver import (DBResolver, STRATEGY_RANDOM,
+                                            primary_reads)
+from gofr_tpu.datasource.sql import SQL, SQLError
+
+
+def make_db(tag: str) -> SQL:
+    db = SQL(database=":memory:")
+    db.connect()
+    db.exec("CREATE TABLE t (id INTEGER PRIMARY KEY, src TEXT)")
+    db.exec("INSERT INTO t (src) VALUES (?)", tag)
+    return db
+
+
+class FailingDB:
+    def __init__(self) -> None:
+        self.calls = 0
+
+    def query(self, *a):
+        self.calls += 1
+        raise SQLError("replica down")
+
+    def use_logger(self, _):
+        pass
+    use_metrics = use_tracer = use_logger
+
+    def connect(self):
+        pass
+
+    def close(self):
+        pass
+
+    def health_check(self):
+        return {"status": "DOWN"}
+
+
+def test_reads_round_robin_replicas_writes_hit_primary():
+    primary, r1, r2 = make_db("p"), make_db("r1"), make_db("r2")
+    res = DBResolver(primary, [r1, r2])
+    seen = {res.query("SELECT src FROM t")[0]["src"] for _ in range(4)}
+    assert seen == {"r1", "r2"}
+    res.exec("INSERT INTO t (src) VALUES (?)", "w")
+    assert len(primary.query("SELECT * FROM t")) == 2
+    assert len(r1.query("SELECT * FROM t")) == 1
+    assert res.stats["writes"] == 1
+    assert res.stats["replica_reads"] == 4
+
+
+def test_write_shaped_query_routes_to_primary():
+    primary, r1 = make_db("p"), make_db("r1")
+    res = DBResolver(primary, [r1])
+    res.query("INSERT INTO t (src) VALUES ('via-query')")
+    assert len(primary.query_row("SELECT COUNT(*) c FROM t").keys()) == 1
+    assert len(primary.query("SELECT * FROM t")) == 2
+    assert len(r1.query("SELECT * FROM t")) == 1
+
+
+def test_primary_reads_context_pins():
+    primary, r1 = make_db("p"), make_db("r1")
+    res = DBResolver(primary, [r1])
+    with primary_reads():
+        assert res.query("SELECT src FROM t")[0]["src"] == "p"
+    assert res.query("SELECT src FROM t")[0]["src"] == "r1"
+
+
+def test_replica_failure_fails_over_and_breaker_opens():
+    primary = make_db("p")
+    bad = FailingDB()
+    res = DBResolver(primary, [bad], breaker_threshold=2,
+                     breaker_recovery=999)
+    for _ in range(3):
+        assert res.query("SELECT src FROM t")[0]["src"] == "p"
+    # breaker opened after 2 failures; third read never touched the replica
+    assert bad.calls == 2
+    assert res.stats["replica_failovers"] == 3
+
+
+def test_breaker_half_open_probe():
+    primary = make_db("p")
+    bad = FailingDB()
+    res = DBResolver(primary, [bad], breaker_threshold=1,
+                     breaker_recovery=0.0)
+    res.query("SELECT src FROM t")
+    res.query("SELECT src FROM t")
+    # recovery=0 → half-open immediately, every read probes the replica
+    assert bad.calls == 2
+
+
+def test_select_and_tx_route_primary():
+    from dataclasses import dataclass
+
+    @dataclass
+    class Row:
+        id: int
+        src: str
+
+    primary, r1 = make_db("p"), make_db("r1")
+    res = DBResolver(primary, [r1], strategy=STRATEGY_RANDOM)
+    rows = res.select(Row, "SELECT * FROM t")
+    assert rows[0].src in ("p", "r1")
+    with pytest.raises(SQLError):
+        res.select(dict, "SELECT * FROM t")
+    with res.begin() as tx:
+        tx.exec("INSERT INTO t (src) VALUES (?)", "tx")
+    assert len(primary.query("SELECT * FROM t")) == 2
+
+
+def test_health_degraded_on_sick_replica():
+    res = DBResolver(make_db("p"), [FailingDB()])
+    h = res.health_check()
+    assert h["status"] == "DEGRADED"
+    assert h["primary"]["status"] == "UP"
