@@ -112,6 +112,38 @@ class App:
         """Append a user middleware (runs innermost, after the chain)."""
         self._user_middlewares.append(middleware)
 
+    # ------------------------------------------------------------- auth
+    def enable_basic_auth(self, **users: str) -> None:
+        """Install basic-auth middleware (reference auth.go:16)."""
+        from .http.auth import BasicAuthProvider, auth_middleware
+        self._middlewares.append(
+            auth_middleware(BasicAuthProvider(users), scheme="Basic"))
+
+    def enable_basic_auth_with_validator(self, validator: Callable) -> None:
+        from .http.auth import BasicAuthProvider, auth_middleware
+        self._middlewares.append(auth_middleware(
+            BasicAuthProvider(validator=validator), scheme="Basic"))
+
+    def enable_api_key_auth(self, *keys: str) -> None:
+        from .http.auth import APIKeyAuthProvider, auth_middleware
+        self._middlewares.append(auth_middleware(
+            APIKeyAuthProvider(list(keys)), scheme="ApiKey"))
+
+    def enable_api_key_auth_with_validator(self, validator: Callable) -> None:
+        from .http.auth import APIKeyAuthProvider, auth_middleware
+        self._middlewares.append(auth_middleware(
+            APIKeyAuthProvider(validator=validator), scheme="ApiKey"))
+
+    def enable_oauth(self, jwks_url: str | None = None, *,
+                     refresh_interval: float = 300.0, **kwargs) -> None:
+        """Install Bearer-JWT auth against a JWKS endpoint
+        (reference auth.go:92)."""
+        from .http.auth import OAuthProvider, auth_middleware
+        kwargs.setdefault("logger", self.logger)
+        provider = OAuthProvider(jwks_url,
+                                 refresh_interval=refresh_interval, **kwargs)
+        self._middlewares.append(auth_middleware(provider, scheme="Bearer"))
+
     # ------------------------------------------------------------ hooks
     def on_start(self, hook: Callable) -> Callable:
         self._on_start.append(hook)

@@ -100,6 +100,9 @@ def build_core_handler(router: Router, container: Container,
         # to keep label cardinality bounded
         request.matched_pattern = route.pattern
         ctx = Context(request=request, container=container)
+        auth_info = getattr(request, "auth_info", None)
+        if auth_info:  # set by auth middleware (reference context.go:121)
+            ctx.set_auth_info(auth_info)
 
         try:
             result = await run_handler(route.handler, ctx, request_timeout)

@@ -1,0 +1,328 @@
+"""Auth middleware: basic, API-key, and OAuth (JWT/JWKS) providers.
+
+Mirrors reference pkg/gofr/http/middleware/{auth,basic_auth,
+apikey_auth,oauth}.go and pkg/gofr/auth.go: a generic
+``auth_middleware(provider)`` wraps the chain; providers authenticate
+the request and attach auth info that surfaces as ``ctx.auth_info``
+(reference context.go:121 GetAuthInfo). ``/.well-known`` paths are
+exempt (reference middleware/validate.go:5-7).
+
+OAuth validates ``Authorization: Bearer <jwt>`` tokens against a JWKS
+key set, refreshed in the background (reference oauth.go:69-138); both
+RS256 (via ``cryptography``) and HS256 are supported.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import binascii
+import hashlib
+import hmac
+import json
+import time
+from typing import Any, Awaitable, Callable, Mapping, Protocol
+
+from .request import HTTPRequest
+from .responder import ResponseData
+from .server import Handler, Middleware
+
+EXEMPT_PREFIXES = ("/.well-known/",)
+
+
+class AuthProvider(Protocol):
+    """Returns auth info on success, None on failure."""
+
+    def authenticate(self, request: HTTPRequest) -> Any: ...
+
+
+def _unauthorized(message: str = "Unauthorized",
+                  scheme: str = "Basic") -> ResponseData:
+    body = json.dumps({"error": {"message": message}}).encode()
+    return ResponseData(status=401, body=body,
+                        headers={"WWW-Authenticate": scheme})
+
+
+def auth_middleware(provider: AuthProvider,
+                    scheme: str = "Basic") -> Middleware:
+    """Generic auth wrapper (reference middleware/auth.go:39)."""
+
+    def mw(next_handler: Handler) -> Handler:
+        async def wrapped(request: HTTPRequest) -> ResponseData:
+            if any(request.path.startswith(p) for p in EXEMPT_PREFIXES):
+                return await next_handler(request)
+            info = provider.authenticate(request)
+            if asyncio.iscoroutine(info):
+                info = await info
+            if info is None:
+                return _unauthorized(scheme=scheme)
+            # surfaced as ctx.auth_info by the core handler
+            request.auth_info = info if isinstance(info, dict) else {"auth": info}
+            return await next_handler(request)
+        return wrapped
+    return mw
+
+
+# --------------------------------------------------------------- basic
+
+class BasicAuthProvider:
+    """Username/password table or custom validator
+    (reference basic_auth.go:116)."""
+
+    def __init__(self, users: Mapping[str, str] | None = None,
+                 validator: Callable[[str, str], bool | Awaitable[bool]] | None = None) -> None:
+        self.users = dict(users or {})
+        self.validator = validator
+
+    def authenticate(self, request: HTTPRequest) -> dict | None:
+        header = request.header("authorization")
+        if not header.startswith("Basic "):
+            return None
+        try:
+            decoded = base64.b64decode(header[6:], validate=True).decode()
+        except (binascii.Error, UnicodeDecodeError):
+            return None
+        username, sep, password = decoded.partition(":")
+        if not sep:
+            return None
+        if self.validator is not None:
+            result = self.validator(username, password)
+            if asyncio.iscoroutine(result):
+                async def check():
+                    return {"username": username} if await result else None
+                return check()  # type: ignore[return-value]
+            return {"username": username} if result else None
+        expected = self.users.get(username)
+        if expected is None or not hmac.compare_digest(expected.encode(),
+                                                       password.encode()):
+            return None
+        return {"username": username}
+
+
+# ------------------------------------------------------------- api key
+
+class APIKeyAuthProvider:
+    """Static key set or custom validator (reference apikey_auth.go:89).
+
+    Keys ride in the ``X-Api-Key`` header."""
+
+    def __init__(self, keys: list[str] | None = None,
+                 validator: Callable[[str], bool | Awaitable[bool]] | None = None) -> None:
+        self.keys = set(keys or [])
+        self.validator = validator
+
+    def authenticate(self, request: HTTPRequest) -> dict | None:
+        key = request.header("x-api-key")
+        if not key:
+            return None
+        if self.validator is not None:
+            result = self.validator(key)
+            if asyncio.iscoroutine(result):
+                async def check():
+                    return {"api_key": key} if await result else None
+                return check()  # type: ignore[return-value]
+            return {"api_key": key} if result else None
+        if any(hmac.compare_digest(key.encode(), k.encode())
+               for k in self.keys):
+            return {"api_key": key}
+        return None
+
+
+# ----------------------------------------------------------------- jwt
+
+def _b64url_decode(segment: str) -> bytes:
+    pad = "=" * (-len(segment) % 4)
+    return base64.urlsafe_b64decode(segment + pad)
+
+
+def _b64url_to_int(segment: str) -> int:
+    return int.from_bytes(_b64url_decode(segment), "big")
+
+
+class JWTError(Exception):
+    pass
+
+
+def _verify_rs256(signing_input: bytes, signature: bytes, key: Any) -> bool:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding
+    try:
+        key.verify(signature, signing_input, padding.PKCS1v15(),
+                   hashes.SHA256())
+        return True
+    except InvalidSignature:
+        return False
+
+
+def jwk_to_public_key(jwk: Mapping[str, Any]) -> Any:
+    """RSA JWK (n, e) -> cryptography public key
+    (reference oauth.go:183 key parsing)."""
+    from cryptography.hazmat.primitives.asymmetric.rsa import RSAPublicNumbers
+    if jwk.get("kty") != "RSA":
+        raise JWTError(f"unsupported kty {jwk.get('kty')!r}")
+    n = _b64url_to_int(jwk["n"])
+    e = _b64url_to_int(jwk["e"])
+    return RSAPublicNumbers(e, n).public_key()
+
+
+def jwt_decode(token: str) -> tuple[dict, dict, bytes, bytes]:
+    """Split a compact JWT -> (header, claims, signing_input, signature)."""
+    parts = token.split(".")
+    if len(parts) != 3:
+        raise JWTError("token is not a compact JWT")
+    try:
+        header = json.loads(_b64url_decode(parts[0]))
+        claims = json.loads(_b64url_decode(parts[1]))
+        signature = _b64url_decode(parts[2])
+    except (ValueError, binascii.Error) as exc:
+        raise JWTError(f"malformed token: {exc}") from exc
+    signing_input = f"{parts[0]}.{parts[1]}".encode()
+    return header, claims, signing_input, signature
+
+
+def jwt_verify(token: str, keys: Mapping[str, Any], *,
+               audience: str | None = None, issuer: str | None = None,
+               leeway: float = 30.0, now: float | None = None) -> dict:
+    """Verify signature + registered claims; returns the claim set.
+
+    ``keys`` maps kid -> RSA public key (cryptography object) or
+    bytes/str HS256 secret. A single key under kid ``""`` is used when
+    the token has no kid.
+    """
+    header, claims, signing_input, signature = jwt_decode(token)
+    alg = header.get("alg")
+    kid = header.get("kid", "")
+    key = keys.get(kid)
+    if key is None and len(keys) == 1:
+        key = next(iter(keys.values()))
+    if key is None:
+        raise JWTError(f"no key for kid {kid!r}")
+
+    if alg == "RS256":
+        if not _verify_rs256(signing_input, signature, key):
+            raise JWTError("signature verification failed")
+    elif alg == "HS256":
+        secret = key.encode() if isinstance(key, str) else key
+        expected = hmac.new(secret, signing_input, hashlib.sha256).digest()
+        if not hmac.compare_digest(expected, signature):
+            raise JWTError("signature verification failed")
+    else:
+        raise JWTError(f"unsupported alg {alg!r}")
+
+    t = time.time() if now is None else now
+    if "exp" in claims and t > float(claims["exp"]) + leeway:
+        raise JWTError("token expired")
+    if "nbf" in claims and t < float(claims["nbf"]) - leeway:
+        raise JWTError("token not yet valid")
+    if audience is not None:
+        aud = claims.get("aud")
+        auds = aud if isinstance(aud, list) else [aud]
+        if audience not in auds:
+            raise JWTError("audience mismatch")
+    if issuer is not None and claims.get("iss") != issuer:
+        raise JWTError("issuer mismatch")
+    return claims
+
+
+def jwt_sign_hs256(claims: Mapping[str, Any], secret: str | bytes,
+                   headers: Mapping[str, Any] | None = None) -> str:
+    """Mint an HS256 token (used by tests and service-to-service auth)."""
+    secret = secret.encode() if isinstance(secret, str) else secret
+    header = {"alg": "HS256", "typ": "JWT", **(headers or {})}
+
+    def enc(obj: Mapping[str, Any]) -> str:
+        raw = json.dumps(obj, separators=(",", ":")).encode()
+        return base64.urlsafe_b64encode(raw).rstrip(b"=").decode()
+
+    signing_input = f"{enc(header)}.{enc(dict(claims))}"
+    sig = hmac.new(secret, signing_input.encode(), hashlib.sha256).digest()
+    return signing_input + "." + base64.urlsafe_b64encode(sig).rstrip(b"=").decode()
+
+
+class OAuthProvider:
+    """Bearer-JWT validation against a JWKS set
+    (reference oauth.go:69-138).
+
+    Keys come from a ``jwks_url`` (refreshed at most every
+    ``refresh_interval`` seconds, fetched lazily on demand — the
+    background-goroutine analog without a dedicated thread), from a
+    static ``jwks`` document, or from explicit ``keys``.
+    """
+
+    FAILURE_BACKOFF = 30.0
+
+    def __init__(self, jwks_url: str | None = None, *,
+                 jwks: Mapping[str, Any] | None = None,
+                 keys: Mapping[str, Any] | None = None,
+                 refresh_interval: float = 300.0,
+                 audience: str | None = None, issuer: str | None = None,
+                 logger: Any = None) -> None:
+        self.jwks_url = jwks_url
+        self.refresh_interval = refresh_interval
+        self.audience = audience
+        self.issuer = issuer
+        self.logger = logger
+        self._keys: dict[str, Any] = dict(keys or {})
+        self._fetched_at = 0.0
+        self._refresh_lock = __import__("threading").Lock()
+        self._refreshing = False
+        if jwks is not None:
+            self._load_jwks(jwks)
+            self._fetched_at = time.time()
+
+    def _load_jwks(self, document: Mapping[str, Any]) -> None:
+        for jwk in document.get("keys", []):
+            try:
+                self._keys[jwk.get("kid", "")] = jwk_to_public_key(jwk)
+            except (JWTError, KeyError) as exc:
+                if self.logger:
+                    self.logger.warn(f"skipping unusable JWK: {exc}")
+
+    def _fetch(self) -> None:
+        import urllib.request
+        try:
+            with urllib.request.urlopen(self.jwks_url, timeout=5) as resp:
+                self._load_jwks(json.loads(resp.read()))
+            self._fetched_at = time.time()
+        except Exception as exc:
+            # advance the clock so a JWKS outage retries on a backoff
+            # instead of on every request
+            self._fetched_at = (time.time() - self.refresh_interval
+                                + self.FAILURE_BACKOFF)
+            if self.logger:
+                self.logger.error(f"JWKS fetch failed: {exc!r}")
+        finally:
+            self._refreshing = False
+
+    def _refresh_if_stale(self) -> None:
+        if self.jwks_url is None:
+            return
+        stale = time.time() - self._fetched_at >= self.refresh_interval
+        if not stale and self._keys:
+            return
+        with self._refresh_lock:
+            if self._refreshing:
+                return
+            self._refreshing = True
+        if self._keys:
+            # have keys: refresh in the background, keep serving
+            import threading
+            threading.Thread(target=self._fetch, daemon=True).start()
+        else:
+            # cold start: nothing to validate against, fetch inline
+            self._fetch()
+
+    def authenticate(self, request: HTTPRequest) -> dict | None:
+        header = request.header("authorization")
+        if not header.startswith("Bearer "):
+            return None
+        self._refresh_if_stale()
+        try:
+            claims = jwt_verify(header[7:], self._keys,
+                                audience=self.audience, issuer=self.issuer)
+        except JWTError as exc:
+            if self.logger:
+                self.logger.debug(f"JWT rejected: {exc}")
+            return None
+        return {"claims": claims}

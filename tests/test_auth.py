@@ -1,0 +1,209 @@
+"""Auth middleware: basic, API-key, OAuth JWT/JWKS — over a real server."""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+
+import pytest
+
+from gofr_tpu.http.auth import (
+    JWTError,
+    OAuthProvider,
+    jwk_to_public_key,
+    jwt_sign_hs256,
+    jwt_verify,
+)
+
+from .apputil import AppRunner
+
+
+def _basic(user: str, password: str) -> dict:
+    token = base64.b64encode(f"{user}:{password}".encode()).decode()
+    return {"Authorization": f"Basic {token}"}
+
+
+def whoami(ctx):
+    return ctx.auth_info
+
+
+class TestBasicAuth:
+    def _runner(self) -> AppRunner:
+        def build(app):
+            app.enable_basic_auth(alice="secret", bob="hunter2")
+            app.get("/whoami", whoami)
+        return AppRunner(build=build)
+
+    def test_valid_credentials(self):
+        with self._runner() as r:
+            status, body = r.get_json("/whoami", headers=_basic("alice", "secret"))
+            assert status == 200
+            assert body["data"]["username"] == "alice"
+
+    def test_wrong_password_and_missing_header(self):
+        with self._runner() as r:
+            status, _, _ = r.request("GET", "/whoami",
+                                     headers=_basic("alice", "nope"))
+            assert status == 401
+            status, headers, _ = r.request("GET", "/whoami")
+            assert status == 401
+            assert headers.get("WWW-Authenticate") == "Basic"
+
+    def test_well_known_exempt(self):
+        with self._runner() as r:
+            status, _ = r.get_json("/.well-known/alive")
+            assert status == 200
+
+    def test_non_ascii_credentials_reject_cleanly(self):
+        with self._runner() as r:
+            status, _, _ = r.request("GET", "/whoami",
+                                     headers=_basic("alice", "pässwörd"))
+            assert status == 401  # not 500
+
+    def test_validator_form(self):
+        def build(app):
+            app.enable_basic_auth_with_validator(
+                lambda u, p: u == "svc" and p == "tok")
+            app.get("/whoami", whoami)
+        with AppRunner(build=build) as r:
+            status, body = r.get_json("/whoami", headers=_basic("svc", "tok"))
+            assert status == 200 and body["data"]["username"] == "svc"
+            status, _, _ = r.request("GET", "/whoami", headers=_basic("svc", "x"))
+            assert status == 401
+
+
+class TestAPIKeyAuth:
+    def test_static_keys(self):
+        def build(app):
+            app.enable_api_key_auth("k1", "k2")
+            app.get("/whoami", whoami)
+        with AppRunner(build=build) as r:
+            status, body = r.get_json("/whoami", headers={"X-Api-Key": "k2"})
+            assert status == 200 and body["data"]["api_key"] == "k2"
+            status, _, _ = r.request("GET", "/whoami",
+                                     headers={"X-Api-Key": "bad"})
+            assert status == 401
+            status, _, _ = r.request("GET", "/whoami")
+            assert status == 401
+
+    def test_validator(self):
+        def build(app):
+            app.enable_api_key_auth_with_validator(
+                lambda k: k.startswith("team-"))
+            app.get("/whoami", whoami)
+        with AppRunner(build=build) as r:
+            status, _ = r.get_json("/whoami", headers={"X-Api-Key": "team-a"})
+            assert status == 200
+
+
+class TestJWT:
+    SECRET = "sekrit"
+
+    def test_hs256_roundtrip(self):
+        token = jwt_sign_hs256({"sub": "u1", "exp": time.time() + 60},
+                               self.SECRET)
+        claims = jwt_verify(token, {"": self.SECRET})
+        assert claims["sub"] == "u1"
+
+    def test_expired(self):
+        token = jwt_sign_hs256({"sub": "u1", "exp": time.time() - 120},
+                               self.SECRET)
+        with pytest.raises(JWTError, match="expired"):
+            jwt_verify(token, {"": self.SECRET})
+
+    def test_bad_signature(self):
+        token = jwt_sign_hs256({"sub": "u1"}, self.SECRET)
+        with pytest.raises(JWTError, match="signature"):
+            jwt_verify(token, {"": "other-secret"})
+
+    def test_audience_issuer(self):
+        token = jwt_sign_hs256({"aud": "api", "iss": "me"}, self.SECRET)
+        jwt_verify(token, {"": self.SECRET}, audience="api", issuer="me")
+        with pytest.raises(JWTError, match="audience"):
+            jwt_verify(token, {"": self.SECRET}, audience="other")
+        with pytest.raises(JWTError, match="issuer"):
+            jwt_verify(token, {"": self.SECRET}, issuer="them")
+
+    def test_rs256_via_jwk(self):
+        from cryptography.hazmat.primitives.asymmetric import padding, rsa
+        from cryptography.hazmat.primitives import hashes
+        private = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        numbers = private.public_key().public_numbers()
+
+        def b64url_int(n: int) -> str:
+            raw = n.to_bytes((n.bit_length() + 7) // 8, "big")
+            return base64.urlsafe_b64encode(raw).rstrip(b"=").decode()
+
+        jwk = {"kty": "RSA", "kid": "k1",
+               "n": b64url_int(numbers.n), "e": b64url_int(numbers.e)}
+
+        def enc(obj) -> str:
+            return base64.urlsafe_b64encode(
+                json.dumps(obj).encode()).rstrip(b"=").decode()
+
+        signing_input = (enc({"alg": "RS256", "kid": "k1"}) + "."
+                         + enc({"sub": "rsa-user"}))
+        sig = private.sign(signing_input.encode(), padding.PKCS1v15(),
+                           hashes.SHA256())
+        token = (signing_input + "."
+                 + base64.urlsafe_b64encode(sig).rstrip(b"=").decode())
+
+        key = jwk_to_public_key(jwk)
+        claims = jwt_verify(token, {"k1": key})
+        assert claims["sub"] == "rsa-user"
+
+        provider = OAuthProvider(jwks={"keys": [jwk]})
+
+        class FakeReq:
+            path = "/x"
+            def header(self, k):
+                return f"Bearer {token}" if k == "authorization" else ""
+        info = provider.authenticate(FakeReq())
+        assert info["claims"]["sub"] == "rsa-user"
+
+
+class TestJWKSRefresh:
+    def test_fetch_failure_backs_off(self):
+        provider = OAuthProvider("http://127.0.0.1:1/jwks",
+                                 refresh_interval=300.0)
+        t0 = time.time()
+        provider._refresh_if_stale()  # inline fetch fails fast (conn refused)
+        assert provider._keys == {}
+        # clock advanced => next attempt only after FAILURE_BACKOFF
+        assert provider._fetched_at > t0 - 300.0 + 25.0
+
+    def test_refresh_serves_stale_keys_without_blocking(self):
+        provider = OAuthProvider("http://127.0.0.1:1/jwks",
+                                 keys={"": "sekrit"}, refresh_interval=0.0)
+        token = jwt_sign_hs256({"sub": "x"}, "sekrit")
+
+        class FakeReq:
+            path = "/x"
+            def header(self, k):
+                return f"Bearer {token}" if k == "authorization" else ""
+        t0 = time.time()
+        info = provider.authenticate(FakeReq())
+        assert info["claims"]["sub"] == "x"
+        assert time.time() - t0 < 1.0  # background refresh, no 5s stall
+
+
+class TestOAuthEndToEnd:
+    def test_bearer_over_server(self):
+        secret = "svc-secret"
+
+        def build(app):
+            from gofr_tpu.http.auth import OAuthProvider, auth_middleware
+            app._middlewares.append(auth_middleware(
+                OAuthProvider(keys={"": secret}, audience="api"),
+                scheme="Bearer"))
+            app.get("/claims", lambda ctx: ctx.auth_info["claims"])
+
+        token = jwt_sign_hs256({"sub": "u9", "aud": "api"}, secret)
+        with AppRunner(build=build) as r:
+            status, body = r.get_json(
+                "/claims", headers={"Authorization": f"Bearer {token}"})
+            assert status == 200 and body["data"]["sub"] == "u9"
+            status, _, _ = r.request(
+                "GET", "/claims", headers={"Authorization": "Bearer junk"})
+            assert status == 401
