@@ -54,6 +54,9 @@ class App:
         self.http_server: HTTPServer | None = None
         self.metrics_server: HTTPServer | None = None
         self._upgrade_handler = None  # installed by websocket support
+        self._ws_router: Router | None = None
+        self._ws_services: dict[str, Any] = {}
+        self._auth_providers: list[Any] = []  # also guard the WS upgrade
 
         self.http_port = self.config.get_int("HTTP_PORT", DEFAULT_HTTP_PORT) \
             if hasattr(self.config, "get_int") else DEFAULT_HTTP_PORT
@@ -113,36 +116,78 @@ class App:
         self._user_middlewares.append(middleware)
 
     # ------------------------------------------------------------- auth
+    def _install_auth(self, provider, scheme: str) -> None:
+        from .http.auth import auth_middleware
+        self._middlewares.append(auth_middleware(provider, scheme=scheme))
+        self._auth_providers.append(provider)
+
     def enable_basic_auth(self, **users: str) -> None:
         """Install basic-auth middleware (reference auth.go:16)."""
-        from .http.auth import BasicAuthProvider, auth_middleware
-        self._middlewares.append(
-            auth_middleware(BasicAuthProvider(users), scheme="Basic"))
+        from .http.auth import BasicAuthProvider
+        self._install_auth(BasicAuthProvider(users), "Basic")
 
     def enable_basic_auth_with_validator(self, validator: Callable) -> None:
-        from .http.auth import BasicAuthProvider, auth_middleware
-        self._middlewares.append(auth_middleware(
-            BasicAuthProvider(validator=validator), scheme="Basic"))
+        from .http.auth import BasicAuthProvider
+        self._install_auth(BasicAuthProvider(validator=validator), "Basic")
 
     def enable_api_key_auth(self, *keys: str) -> None:
-        from .http.auth import APIKeyAuthProvider, auth_middleware
-        self._middlewares.append(auth_middleware(
-            APIKeyAuthProvider(list(keys)), scheme="ApiKey"))
+        from .http.auth import APIKeyAuthProvider
+        self._install_auth(APIKeyAuthProvider(list(keys)), "ApiKey")
 
     def enable_api_key_auth_with_validator(self, validator: Callable) -> None:
-        from .http.auth import APIKeyAuthProvider, auth_middleware
-        self._middlewares.append(auth_middleware(
-            APIKeyAuthProvider(validator=validator), scheme="ApiKey"))
+        from .http.auth import APIKeyAuthProvider
+        self._install_auth(APIKeyAuthProvider(validator=validator), "ApiKey")
 
     def enable_oauth(self, jwks_url: str | None = None, *,
                      refresh_interval: float = 300.0, **kwargs) -> None:
         """Install Bearer-JWT auth against a JWKS endpoint
         (reference auth.go:92)."""
-        from .http.auth import OAuthProvider, auth_middleware
+        from .http.auth import OAuthProvider
         kwargs.setdefault("logger", self.logger)
         provider = OAuthProvider(jwks_url,
                                  refresh_interval=refresh_interval, **kwargs)
-        self._middlewares.append(auth_middleware(provider, scheme="Bearer"))
+        self._install_auth(provider, "Bearer")
+
+    # -------------------------------------------------------- websockets
+    def websocket(self, pattern: str, handler: Callable | None = None):
+        """Register a websocket endpoint: the handler runs once per
+        inbound message, ``ctx.bind()`` reads the frame
+        (reference websocket.go:30-49)."""
+        if handler is None:
+            def decorator(fn: Callable) -> Callable:
+                self.websocket(pattern, fn)
+                return fn
+            return decorator
+
+        if self._ws_router is None:
+            from .websocket.manager import WSManager
+            self._ws_router = Router()
+            if self.container.ws_manager is None:
+                self.container.ws_manager = WSManager()
+        self._ws_router.add("WS", pattern, handler)
+
+        async def reject_plain_http(ctx) -> Any:
+            from .http.errors import HTTPError
+            raise HTTPError("websocket endpoint: upgrade required",
+                            status_code=426)
+        self.router.add("GET", pattern, reject_plain_http)
+        return handler
+
+    def add_ws_service(self, name: str, url: str, *,
+                       headers: dict[str, str] | None = None,
+                       retry_interval: float = 5.0,
+                       on_message: Callable | None = None):
+        """Maintain a named outbound WS connection with reconnection
+        (reference websocket.go:52-98)."""
+        from .websocket.service import WSService
+        service = WSService(name, url, headers=headers,
+                            retry_interval=retry_interval,
+                            logger=self.logger, on_message=on_message)
+        self._ws_services[name] = service
+        self.container.register_ws_service(name, service)
+        self.on_start(lambda c: service.start())
+        self.on_shutdown(service.stop)
+        return service
 
     # ------------------------------------------------------------ hooks
     def on_start(self, hook: Callable) -> Callable:
@@ -242,6 +287,12 @@ class App:
         if not await self._run_start_hooks():
             raise RuntimeError("on_start hook failed")
 
+        if self._ws_router is not None and self._upgrade_handler is None:
+            from .websocket.runtime import make_upgrade_handler
+            self._upgrade_handler = make_upgrade_handler(
+                self._ws_router, self.container, self._auth_providers,
+                self.logger)
+
         handler = self._build_http_handler()
         self.http_server = HTTPServer(
             handler, host="0.0.0.0", port=self.http_port, logger=self.logger,
@@ -279,6 +330,8 @@ class App:
                 self.logger.warn(f"shutdown hook: {exc!r}")
         for task in self._tasks:
             task.cancel()
+        if self.container.ws_manager is not None:
+            await self.container.ws_manager.close_all()
         for server in self._servers:
             await server.shutdown()
         self._servers.clear()

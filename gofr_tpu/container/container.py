@@ -44,6 +44,7 @@ class Container:
         self.kv: Any = None                  # key-value store
         self.file: Any = None                # file store
         self.ws_manager: Any = None          # websocket connection manager
+        self.ws_services: dict[str, Any] = {}  # name -> outbound WSService
         self.tpu: Any = None                 # TPU device registry / runtime
         self.models: dict[str, Any] = {}     # name -> serving engine
         self._start_time = time.time()
@@ -202,6 +203,12 @@ class Container:
 
     def register_service(self, name: str, service: Any) -> None:
         self.services[name] = service
+
+    def register_ws_service(self, name: str, service: Any) -> None:
+        self.ws_services[name] = service
+
+    def get_ws_service(self, name: str) -> Any:
+        return self.ws_services.get(name)
 
     def get_http_service(self, name: str) -> Any:
         return self.services.get(name)

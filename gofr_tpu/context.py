@@ -25,6 +25,7 @@ class Context:
         self.terminal = terminal
         self.logger = ContextLogger(container.logger)
         self._auth_info: dict[str, Any] = {}
+        self._ws_conn: Any = None  # set by the websocket runtime
 
     # -- request surface (reference context delegates to Request)
     def bind(self, target: Any = None) -> Any:
@@ -100,6 +101,16 @@ class Context:
 
     def set_auth_info(self, info: dict[str, Any]) -> None:
         self._auth_info = dict(info)
+
+    # -- websocket (reference context.go:81 WriteMessageToSocket)
+    async def write_message_to_socket(self, data: Any) -> None:
+        if self._ws_conn is None:
+            raise RuntimeError("not a websocket context")
+        await self._ws_conn.send(data)
+
+    @property
+    def ws_manager(self):
+        return self.container.ws_manager
 
     # -- publish convenience
     async def publish(self, topic: str, message: bytes | str | dict) -> None:

@@ -43,21 +43,35 @@ def _unauthorized(message: str = "Unauthorized",
                         headers={"WWW-Authenticate": scheme})
 
 
+def is_exempt(path: str) -> bool:
+    return any(path.startswith(p) for p in EXEMPT_PREFIXES)
+
+
+async def run_provider(provider: AuthProvider,
+                       request: HTTPRequest) -> bool:
+    """Authenticate and attach auth info to the request. The single
+    authority for provider semantics — the middleware chain and the
+    websocket upgrade path both call this."""
+    info = provider.authenticate(request)
+    if asyncio.iscoroutine(info):
+        info = await info
+    if info is None:
+        return False
+    # surfaced as ctx.auth_info by the core handler
+    request.auth_info = info if isinstance(info, dict) else {"auth": info}
+    return True
+
+
 def auth_middleware(provider: AuthProvider,
                     scheme: str = "Basic") -> Middleware:
     """Generic auth wrapper (reference middleware/auth.go:39)."""
 
     def mw(next_handler: Handler) -> Handler:
         async def wrapped(request: HTTPRequest) -> ResponseData:
-            if any(request.path.startswith(p) for p in EXEMPT_PREFIXES):
+            if is_exempt(request.path):
                 return await next_handler(request)
-            info = provider.authenticate(request)
-            if asyncio.iscoroutine(info):
-                info = await info
-            if info is None:
+            if not await run_provider(provider, request):
                 return _unauthorized(scheme=scheme)
-            # surfaced as ctx.auth_info by the core handler
-            request.auth_info = info if isinstance(info, dict) else {"auth": info}
             return await next_handler(request)
         return wrapped
     return mw

@@ -32,7 +32,8 @@ _STATUS_REASONS = {
     303: "See Other", 304: "Not Modified", 400: "Bad Request",
     401: "Unauthorized", 403: "Forbidden", 404: "Not Found",
     405: "Method Not Allowed", 408: "Request Timeout", 409: "Conflict",
-    413: "Payload Too Large", 429: "Too Many Requests",
+    413: "Payload Too Large", 426: "Upgrade Required",
+    429: "Too Many Requests",
     431: "Request Header Fields Too Large",
     499: "Client Closed Request", 500: "Internal Server Error",
     501: "Not Implemented", 503: "Service Unavailable",

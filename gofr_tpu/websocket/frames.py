@@ -1,0 +1,124 @@
+"""RFC 6455 frame codec: encode/decode over asyncio streams.
+
+The wire layer under the framework's websocket support — the role
+gorilla/websocket's framing plays for the reference
+(pkg/gofr/websocket/). Server-to-client frames are unmasked,
+client-to-server frames are masked, as the RFC requires.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import struct
+from dataclasses import dataclass
+
+OP_CONT = 0x0
+OP_TEXT = 0x1
+OP_BINARY = 0x2
+OP_CLOSE = 0x8
+OP_PING = 0x9
+OP_PONG = 0xA
+
+CONTROL_OPS = (OP_CLOSE, OP_PING, OP_PONG)
+
+CLOSE_NORMAL = 1000
+CLOSE_GOING_AWAY = 1001
+CLOSE_PROTOCOL_ERROR = 1002
+CLOSE_UNSUPPORTED = 1003
+CLOSE_TOO_LARGE = 1009
+CLOSE_INTERNAL = 1011
+
+MAX_FRAME_BYTES = 16 * 1024 * 1024
+
+WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+def accept_key(key: str) -> str:
+    """Sec-WebSocket-Accept derivation, shared by server and client."""
+    import base64
+    import hashlib
+    return base64.b64encode(
+        hashlib.sha1((key + WS_GUID).encode()).digest()).decode()
+
+
+class WSProtocolError(Exception):
+    def __init__(self, message: str, code: int = CLOSE_PROTOCOL_ERROR) -> None:
+        super().__init__(message)
+        self.code = code
+
+
+@dataclass
+class Frame:
+    opcode: int
+    payload: bytes
+    fin: bool = True
+
+
+def encode_frame(opcode: int, payload: bytes, *, fin: bool = True,
+                 mask: bool = False) -> bytes:
+    head = bytearray()
+    head.append((0x80 if fin else 0) | opcode)
+    length = len(payload)
+    mask_bit = 0x80 if mask else 0
+    if length < 126:
+        head.append(mask_bit | length)
+    elif length < 1 << 16:
+        head.append(mask_bit | 126)
+        head += struct.pack(">H", length)
+    else:
+        head.append(mask_bit | 127)
+        head += struct.pack(">Q", length)
+    if mask:
+        key = os.urandom(4)
+        head += key
+        payload = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
+    return bytes(head) + payload
+
+
+async def read_frame(reader: asyncio.StreamReader, *,
+                     require_mask: bool) -> Frame | None:
+    """Read one frame; None on clean EOF at a frame boundary."""
+    try:
+        head = await reader.readexactly(2)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    fin = bool(head[0] & 0x80)
+    if head[0] & 0x70:
+        raise WSProtocolError("nonzero RSV bits")
+    opcode = head[0] & 0x0F
+    masked = bool(head[1] & 0x80)
+    length = head[1] & 0x7F
+
+    if opcode in CONTROL_OPS and (not fin or length > 125):
+        raise WSProtocolError("fragmented or oversized control frame")
+    if masked != require_mask:
+        raise WSProtocolError(
+            "client frames must be masked" if require_mask
+            else "server frames must not be masked")
+
+    try:
+        if length == 126:
+            length = struct.unpack(">H", await reader.readexactly(2))[0]
+        elif length == 127:
+            length = struct.unpack(">Q", await reader.readexactly(8))[0]
+        if length > MAX_FRAME_BYTES:
+            raise WSProtocolError("frame too large", CLOSE_TOO_LARGE)
+        key = await reader.readexactly(4) if masked else b""
+        payload = await reader.readexactly(length) if length else b""
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    if masked and payload:
+        payload = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
+    return Frame(opcode=opcode, payload=payload, fin=fin)
+
+
+def close_payload(code: int, reason: str = "") -> bytes:
+    return struct.pack(">H", code) + reason.encode()[:123]
+
+
+def parse_close(payload: bytes) -> tuple[int, str]:
+    if len(payload) < 2:
+        return CLOSE_NORMAL, ""
+    code = struct.unpack(">H", payload[:2])[0]
+    return code, payload[2:].decode("utf-8", "replace")

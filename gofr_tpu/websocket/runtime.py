@@ -1,0 +1,174 @@
+"""Server-side websocket runtime: upgrade handshake + message loop.
+
+The glue between the HTTP server's upgrade hook and user handlers —
+reference pkg/gofr/websocket.go:30-49 (App.WebSocket registers a GET
+route whose handler loop calls the user Handler per message, with
+``ctx.bind`` reading a frame) and middleware/web_socket.go:14-37
+(upgrade + Manager registration keyed by Sec-WebSocket-Key).
+
+Auth: installed auth providers run BEFORE the handshake, so protected
+apps never serve anonymous websockets (the upgrade path cannot bypass
+the middleware chain).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Mapping
+
+from ..http.auth import is_exempt, run_provider
+from ..http.request import HTTPRequest, bind_dataclass
+from .connection import WSConnection, WSMessage
+from .frames import accept_key
+
+# strong refs: the event loop only weakly references tasks, so
+# per-connection loops must be anchored or GC can kill live sockets
+_LOOP_TASKS: set[asyncio.Task] = set()
+
+
+class WSRequest:
+    """Request implementation wrapping one inbound frame: ``bind``
+    parses the frame payload, params come from the upgrade request."""
+
+    def __init__(self, upgrade: HTTPRequest, message: WSMessage,
+                 path_params: Mapping[str, str]) -> None:
+        self._upgrade = upgrade
+        self.message = message
+        self._path_params = dict(path_params)
+
+    def param(self, key: str) -> str:
+        return self._upgrade.param(key)
+
+    def params(self, key: str) -> list[str]:
+        return self._upgrade.params(key)
+
+    def path_param(self, key: str) -> str:
+        return self._path_params.get(key, "")
+
+    def host_name(self) -> str:
+        return self._upgrade.host_name()
+
+    def header(self, key: str) -> str:
+        return self._upgrade.header(key)
+
+    def bind(self, target: Any = None) -> Any:
+        """Frame payload -> str, parsed JSON, or bound dataclass."""
+        if not self.message.is_text:
+            return bytes(self.message.data)
+        text = self.message.text()
+        if target is str or (target is None and not _looks_like_json(text)):
+            return text
+        try:
+            data = json.loads(text)
+        except json.JSONDecodeError:
+            if target is None:
+                return text
+            raise
+        if target is None or not isinstance(target, type):
+            return data
+        import dataclasses
+        if dataclasses.is_dataclass(target) and isinstance(data, Mapping):
+            return bind_dataclass(data, target)
+        return data
+
+
+def _looks_like_json(text: str) -> bool:
+    stripped = text.lstrip()
+    return stripped[:1] in ("{", "[", '"') or stripped in ("true", "false",
+                                                           "null") \
+        or stripped[:1].isdigit() or stripped[:1] == "-"
+
+
+def make_upgrade_handler(ws_router, container, auth_providers,
+                         logger) -> Any:
+    """Build the server's upgrade hook:
+    async (request, reader, writer) -> took_over."""
+
+    async def upgrade(request: HTTPRequest, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> bool:
+        matched = ws_router.match("WS", request.path)
+        if matched is None:
+            return False  # not a WS route; normal chain answers
+        if request.headers.get("upgrade", "").lower() != "websocket":
+            return False
+        key = request.headers.get("sec-websocket-key", "")
+        if not key:
+            return False  # malformed; GET route answers 400/426
+        if request.headers.get("sec-websocket-version", "") != "13":
+            # RFC 6455 4.2.2: advertise the version we speak
+            writer.write(b"HTTP/1.1 426 Upgrade Required\r\n"
+                         b"Sec-WebSocket-Version: 13\r\n"
+                         b"Connection: close\r\n"
+                         b"Content-Length: 0\r\n\r\n")
+            await writer.drain()
+            writer.close()
+            return True
+
+        # auth runs BEFORE the handshake (same provider semantics as the
+        # middleware chain); on failure fall through to the normal chain,
+        # which produces the 401
+        if not is_exempt(request.path):
+            for provider in auth_providers:
+                if not await run_provider(provider, request):
+                    return False
+
+        route, path_params = matched
+        headers = ["HTTP/1.1 101 Switching Protocols", "Upgrade: websocket",
+                   "Connection: Upgrade",
+                   f"Sec-WebSocket-Accept: {accept_key(key)}"]
+        writer.write(("\r\n".join(headers) + "\r\n\r\n").encode())
+        await writer.drain()
+
+        conn = WSConnection(reader, writer, conn_id=key)
+        if container.ws_manager is not None:
+            container.ws_manager.add(key, conn)
+        task = asyncio.ensure_future(_message_loop(
+            route.handler, request, conn, path_params, container, logger))
+        _LOOP_TASKS.add(task)
+        task.add_done_callback(_LOOP_TASKS.discard)
+        return True
+
+    return upgrade
+
+
+async def _message_loop(handler, upgrade_request: HTTPRequest,
+                        conn: WSConnection, path_params, container,
+                        logger) -> None:
+    """Per-message handler dispatch (reference websocket.go:100-117)."""
+    from ..context import Context
+    try:
+        while True:
+            message = await conn.recv()
+            if message is None:
+                break
+            ctx = Context(request=WSRequest(upgrade_request, message,
+                                            path_params),
+                          container=container)
+            ctx._ws_conn = conn
+            auth_info = getattr(upgrade_request, "auth_info", None)
+            if auth_info:
+                ctx.set_auth_info(auth_info)
+            try:
+                result = handler(ctx)
+                if hasattr(result, "__await__"):
+                    result = await result
+                if result is not None:
+                    await conn.send(result)
+            except (ConnectionError, asyncio.CancelledError):
+                raise
+            except Exception as exc:  # handler panic: log, keep the conn
+                logger.error(f"ws handler error on {upgrade_request.path}: "
+                             f"{exc!r}")
+                try:
+                    await conn.send({"error": str(exc) or
+                                     exc.__class__.__name__})
+                except (ConnectionError, RuntimeError):
+                    break
+    except (ConnectionError, asyncio.CancelledError):
+        pass
+    finally:
+        if container.ws_manager is not None:
+            container.ws_manager.remove(conn.conn_id)
+        if not conn.closed:
+            await conn.close()

@@ -1,0 +1,210 @@
+"""Websocket layer: handshake, echo, bind, streaming, manager, auth."""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+from dataclasses import dataclass
+
+import pytest
+
+from gofr_tpu.websocket import WSHandshakeError, connect
+from gofr_tpu.websocket.service import WSService
+
+from .apputil import AppRunner
+
+
+@dataclass
+class ChatMessage:
+    user: str
+    text: str
+
+
+def build_echo(app):
+    @app.websocket("/ws/echo")
+    def echo(ctx):
+        return {"echo": ctx.bind(str)}
+
+    @app.websocket("/ws/chat/{room}")
+    def chat(ctx):
+        msg = ctx.bind(ChatMessage)
+        return {"room": ctx.path_param("room"), "from": msg.user,
+                "text": msg.text.upper()}
+
+    @app.websocket("/ws/stream")
+    async def stream(ctx):
+        n = int(ctx.bind(str))
+        for i in range(n):
+            await ctx.write_message_to_socket({"token": i})
+        return {"done": n}
+
+    @app.websocket("/ws/boom")
+    def boom(ctx):
+        raise ValueError("handler exploded")
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 15))
+
+
+class TestWebSocket:
+    def test_echo_roundtrip(self):
+        with AppRunner(build=build_echo) as r:
+            async def go():
+                conn = await connect(f"ws://127.0.0.1:{r.port}/ws/echo")
+                await conn.send("hello")
+                reply = await conn.recv()
+                assert reply is not None
+                import json
+                assert json.loads(reply.text()) == {"echo": "hello"}
+                await conn.close()
+            run(go())
+
+    def test_dataclass_bind_and_path_params(self):
+        with AppRunner(build=build_echo) as r:
+            async def go():
+                conn = await connect(f"ws://127.0.0.1:{r.port}/ws/chat/tpu")
+                await conn.send({"user": "ada", "text": "hi"})
+                import json
+                reply = json.loads((await conn.recv()).text())
+                assert reply == {"room": "tpu", "from": "ada", "text": "HI"}
+                await conn.close()
+            run(go())
+
+    def test_streaming_write_message_to_socket(self):
+        with AppRunner(build=build_echo) as r:
+            async def go():
+                conn = await connect(f"ws://127.0.0.1:{r.port}/ws/stream")
+                await conn.send("3")
+                import json
+                got = [json.loads((await conn.recv()).text())
+                       for _ in range(4)]
+                assert got == [{"token": 0}, {"token": 1}, {"token": 2},
+                               {"done": 3}]
+                await conn.close()
+            run(go())
+
+    def test_handler_error_keeps_connection(self):
+        with AppRunner(build=build_echo) as r:
+            async def go():
+                conn = await connect(f"ws://127.0.0.1:{r.port}/ws/boom")
+                await conn.send("x")
+                import json
+                reply = json.loads((await conn.recv()).text())
+                assert "error" in reply
+                # connection survives; next message also answered
+                await conn.send("y")
+                assert (await conn.recv()) is not None
+                await conn.close()
+            run(go())
+
+    def test_ping_pong_and_large_message(self):
+        with AppRunner(build=build_echo) as r:
+            async def go():
+                conn = await connect(f"ws://127.0.0.1:{r.port}/ws/echo")
+                await conn.ping(b"hb")  # pong handled inside recv
+                big = "x" * 70000  # forces 16-bit extended length
+                await conn.send(big)
+                import json
+                reply = json.loads((await conn.recv()).text())
+                assert reply["echo"] == big
+                await conn.close()
+            run(go())
+
+    def test_plain_http_get_is_426(self):
+        with AppRunner(build=build_echo) as r:
+            status, _, _ = r.request("GET", "/ws/echo")
+            assert status == 426
+
+    def test_unknown_ws_path_rejected(self):
+        with AppRunner(build=build_echo) as r:
+            async def go():
+                with pytest.raises(WSHandshakeError):
+                    await connect(f"ws://127.0.0.1:{r.port}/ws/nope")
+            run(go())
+
+
+class TestManagerBroadcast:
+    def test_broadcast_reaches_all(self):
+        received = asyncio.Event()
+
+        def build(app):
+            build_echo(app)
+
+            @app.get("/announce")
+            async def announce(ctx):
+                n = await ctx.ws_manager.broadcast({"announcement": "hi"})
+                return {"sent": n}
+        with AppRunner(build=build) as r:
+            async def go():
+                a = await connect(f"ws://127.0.0.1:{r.port}/ws/echo")
+                b = await connect(f"ws://127.0.0.1:{r.port}/ws/echo")
+                await asyncio.sleep(0.05)  # let server register both
+                status, body = r.get_json("/announce")
+                assert status == 200 and body["data"]["sent"] == 2
+                import json
+                assert json.loads((await a.recv()).text()) == \
+                    {"announcement": "hi"}
+                assert json.loads((await b.recv()).text()) == \
+                    {"announcement": "hi"}
+                await a.close()
+                await b.close()
+            run(go())
+
+
+class TestWebSocketAuth:
+    def _build(self, app):
+        app.enable_basic_auth(alice="pw")
+        build_echo(app)
+
+    def test_handshake_requires_auth(self):
+        with AppRunner(build=self._build) as r:
+            async def go():
+                with pytest.raises(WSHandshakeError, match="401"):
+                    await connect(f"ws://127.0.0.1:{r.port}/ws/echo")
+            run(go())
+
+    def test_handshake_with_credentials(self):
+        with AppRunner(build=self._build) as r:
+            token = base64.b64encode(b"alice:pw").decode()
+            async def go():
+                conn = await connect(
+                    f"ws://127.0.0.1:{r.port}/ws/echo",
+                    headers={"Authorization": f"Basic {token}"})
+                await conn.send("hi")
+                assert (await conn.recv()) is not None
+                await conn.close()
+            run(go())
+
+
+class TestWSService:
+    def test_outbound_service_send_and_receive(self):
+        inbound: list[str] = []
+
+        def build(app):
+            build_echo(app)
+        with AppRunner(build=build) as r:
+            async def go():
+                got = asyncio.Event()
+
+                def on_message(msg):
+                    inbound.append(msg.text())
+                    got.set()
+                service = WSService("peer",
+                                    f"ws://127.0.0.1:{r.port}/ws/echo",
+                                    retry_interval=0.2,
+                                    on_message=on_message)
+                await service.start()
+                assert await service.wait_connected(10)
+                await service.send("ping")
+                await asyncio.wait_for(got.wait(), 10)
+                assert "ping" in inbound[0]
+                await service.stop()
+            run(go())
+
+    def test_service_reports_disconnected(self):
+        async def go():
+            service = WSService("down", "ws://127.0.0.1:9/ws", retry_interval=5)
+            with pytest.raises(ConnectionError):
+                await service.send("x")
+        run(go())
