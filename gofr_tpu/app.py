@@ -53,7 +53,7 @@ class App:
         self._shutdown_task: asyncio.Task | None = None
         self.http_server: HTTPServer | None = None
         self.metrics_server: HTTPServer | None = None
-        self._upgrade_handler = None  # installed by websocket support
+        self.grpc_server = None  # created on first register_grpc_service
         self._ws_router: Router | None = None
         self._ws_services: dict[str, Any] = {}
         self._auth_providers: list[Any] = []  # also guard the WS upgrade
@@ -173,6 +173,18 @@ class App:
         self.router.add("GET", pattern, reject_plain_http)
         return handler
 
+    # --------------------------------------------------------------- gRPC
+    def register_grpc_service(self, service) -> None:
+        """Queue a GRPCService; the gRPC server boots with the app
+        (reference grpc.go:200 RegisterService)."""
+        if self.grpc_server is None:
+            from .grpc.server import DEFAULT_GRPC_PORT, GRPCServer
+            port = self.config.get_int("GRPC_PORT", DEFAULT_GRPC_PORT) \
+                if hasattr(self.config, "get_int") else DEFAULT_GRPC_PORT
+            self.grpc_server = GRPCServer(self.container, port=port,
+                                          logger=self.logger)
+        self.grpc_server.register(service)
+
     def add_ws_service(self, name: str, url: str, *,
                        headers: dict[str, str] | None = None,
                        retry_interval: float = 5.0,
@@ -246,6 +258,12 @@ class App:
         ]
         middlewares.extend(self._middlewares)
         middlewares.extend(self._user_middlewares)
+        if self._ws_router is not None:
+            # innermost, after auth + user middleware (reference
+            # http_server.go:36-41 ordering)
+            from .websocket.runtime import make_ws_middleware
+            middlewares.append(make_ws_middleware(
+                self._ws_router, self.container, self.logger))
         return chain(middlewares, core)
 
     def _build_metrics_handler(self):
@@ -287,16 +305,10 @@ class App:
         if not await self._run_start_hooks():
             raise RuntimeError("on_start hook failed")
 
-        if self._ws_router is not None and self._upgrade_handler is None:
-            from .websocket.runtime import make_upgrade_handler
-            self._upgrade_handler = make_upgrade_handler(
-                self._ws_router, self.container, self._auth_providers,
-                self.logger)
-
         handler = self._build_http_handler()
         self.http_server = HTTPServer(
-            handler, host="0.0.0.0", port=self.http_port, logger=self.logger,
-            upgrade_handler=self._upgrade_handler)
+            handler, host="0.0.0.0", port=self.http_port,
+            logger=self.logger)
         await self.http_server.start()
         self._servers.append(self.http_server)
 
@@ -305,6 +317,9 @@ class App:
             port=self.metrics_port, logger=self.logger)
         await self.metrics_server.start()
         self._servers.append(self.metrics_server)
+
+        if self.grpc_server is not None:
+            await self.grpc_server.start()
 
         if self._subscriptions:
             from .pubsub.subscriber import SubscriptionManager
@@ -332,6 +347,8 @@ class App:
             task.cancel()
         if self.container.ws_manager is not None:
             await self.container.ws_manager.close_all()
+        if self.grpc_server is not None:
+            await self.grpc_server.shutdown()
         for server in self._servers:
             await server.shutdown()
         self._servers.clear()

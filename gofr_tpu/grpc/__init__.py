@@ -1,0 +1,17 @@
+"""gRPC transport: asyncio server with observability interceptors,
+decorator-based services, standard health, and client helpers."""
+
+from .client import GRPCClient
+from .health import NOT_SERVING, SERVING, SERVICE_UNKNOWN
+from .server import GRPCServer
+from .service import (
+    GRPCService,
+    bidi_stream_rpc,
+    client_stream_rpc,
+    rpc,
+    server_stream_rpc,
+)
+
+__all__ = ["GRPCServer", "GRPCClient", "GRPCService", "rpc",
+           "server_stream_rpc", "client_stream_rpc", "bidi_stream_rpc",
+           "SERVING", "NOT_SERVING", "SERVICE_UNKNOWN"]

@@ -1,0 +1,66 @@
+"""gRPC client helpers: JSON-codec calls against GRPCService servers,
+plus standard health checks — the counterpart of the reference's
+generated client glue (examples/grpc/grpc-unary-client)."""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator
+
+import grpc
+
+from .health import decode_check_response, encode_check_request, status_name
+from .service import _json_deserialize, _json_serialize
+
+
+class GRPCClient:
+    """Thin aio channel wrapper; one per target."""
+
+    def __init__(self, target: str, *, tracer: Any = None) -> None:
+        self.target = target
+        self.tracer = tracer
+        self._channel: grpc.aio.Channel | None = None
+
+    def _chan(self) -> grpc.aio.Channel:
+        if self._channel is None:
+            self._channel = grpc.aio.insecure_channel(self.target)
+        return self._channel
+
+    def _metadata(self) -> list[tuple[str, str]]:
+        if self.tracer is None:
+            return []
+        span = self.tracer.current_span()
+        if span is None:
+            return []
+        return [("traceparent",
+                 f"00-{span.trace_id}-{span.span_id}-01")]
+
+    async def call(self, service: str, method: str, payload: Any = None, *,
+                   timeout: float | None = None) -> Any:
+        rpc = self._chan().unary_unary(
+            f"/{service}/{method}",
+            request_serializer=_json_serialize,
+            response_deserializer=_json_deserialize)
+        return await rpc(payload if payload is not None else {},
+                         timeout=timeout, metadata=self._metadata())
+
+    async def stream(self, service: str, method: str,
+                     payload: Any = None) -> AsyncIterator[Any]:
+        rpc = self._chan().unary_stream(
+            f"/{service}/{method}",
+            request_serializer=_json_serialize,
+            response_deserializer=_json_deserialize)
+        async for item in rpc(payload if payload is not None else {},
+                              metadata=self._metadata()):
+            yield item
+
+    async def health_check(self, service: str = "") -> str:
+        rpc = self._chan().unary_unary(
+            "/grpc.health.v1.Health/Check",
+            request_serializer=lambda s: encode_check_request(s),
+            response_deserializer=decode_check_response)
+        return status_name(await rpc(service))
+
+    async def close(self) -> None:
+        if self._channel is not None:
+            await self._channel.close()
+            self._channel = None

@@ -1,0 +1,243 @@
+"""Asyncio gRPC server with the framework's observability chain.
+
+The role of reference pkg/gofr/grpc.go: a gRPC transport sharing the
+HTTP server's observability — every RPC gets panic recovery, a span
+(propagated from ``traceparent`` metadata), a structured log line, and
+an ``app_grpc_server_duration`` histogram (grpc.go:96-119,
+grpc/log.go:150-284). Services are ``GRPCService`` subclasses with
+container injection at registration (grpc.go:222-269); the standard
+``grpc.health.v1.Health`` service is registered automatically, backed
+by the container's aggregate health (health_gofr.go:21-34).
+
+Runs on ``grpc.aio`` so server-streaming RPCs can consume the serving
+engine's async token streams directly — no thread hops on the token
+path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import traceback
+from typing import Any, AsyncIterator, Mapping
+
+import grpc
+
+from ..context import Context
+from .health import (
+    NOT_SERVING,
+    SERVING,
+    HealthState,
+    decode_check_request,
+    encode_check_response,
+)
+from .service import (
+    BIDI_STREAM,
+    CLIENT_STREAM,
+    SERVER_STREAM,
+    UNARY,
+    GRPCService,
+    RPCSpec,
+)
+
+DEFAULT_GRPC_PORT = 9000
+
+# 5ms-10s, the reference's gRPC latency buckets (health_gofr.go:42-44)
+_GRPC_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2, 5, 10)
+
+
+class GRPCRequest:
+    """Request implementation for RPC handlers: ``bind`` returns the
+    decoded request; ``param`` reads invocation metadata."""
+
+    def __init__(self, payload: Any, metadata: Mapping[str, str],
+                 method: str) -> None:
+        self.payload = payload
+        self.metadata = dict(metadata)
+        self.method = method
+
+    def bind(self, target: Any = None) -> Any:
+        if target is not None and isinstance(self.payload, Mapping) \
+                and isinstance(target, type):
+            import dataclasses
+            if dataclasses.is_dataclass(target):
+                from ..http.request import bind_dataclass
+                return bind_dataclass(self.payload, target)
+        return self.payload
+
+    def param(self, key: str) -> str:
+        return self.metadata.get(key.lower(), "")
+
+    def params(self, key: str) -> list[str]:
+        value = self.param(key)
+        return value.split(",") if value else []
+
+    def path_param(self, key: str) -> str:
+        return ""
+
+    def host_name(self) -> str:
+        return self.metadata.get(":authority", "")
+
+    def header(self, key: str) -> str:
+        return self.param(key)
+
+
+class GRPCServer:
+    def __init__(self, container: Any, *, port: int = DEFAULT_GRPC_PORT,
+                 logger: Any = None) -> None:
+        self.container = container
+        self.port = port
+        self.logger = logger if logger is not None else container.logger
+        self.health = HealthState()
+        self._services: list[GRPCService] = []
+        self._server: grpc.aio.Server | None = None
+        self.bound_port: int = port
+        container.metrics.new_histogram(
+            "app_grpc_server_duration", "gRPC server handle time in seconds",
+            buckets=_GRPC_BUCKETS)
+
+    # ------------------------------------------------------- registration
+    def register(self, service: GRPCService) -> None:
+        """Inject the container and queue the service
+        (reference grpc.go:200-269 RegisterService)."""
+        if not service.name:
+            raise ValueError(
+                f"{type(service).__name__}.name must be the fully-qualified "
+                "gRPC service name")
+        service.container = self.container
+        self._services.append(service)
+        self.health.set(service.name, SERVING)
+
+    # ------------------------------------------------------ observability
+    def _observed(self, service: GRPCService, spec: RPCSpec):
+        """recovery + span + log + metrics around one RPC
+        (reference grpc/log.go:150-284)."""
+        full_method = f"/{service.name}/{spec.name}"
+        tracer = self.container.tracer
+        metrics = self.container.metrics
+        logger = self.logger
+
+        def observe(start: float, status: str) -> None:
+            duration = time.perf_counter() - start
+            metrics.record_histogram("app_grpc_server_duration", duration,
+                                     method=full_method, status=status)
+            record = {"method": full_method, "status": status,
+                      "duration_us": int(duration * 1e6), "kind": "grpc"}
+            (logger.info if status == "OK" else logger.error)(record)
+
+        def make_ctx(payload: Any, grpc_ctx) -> Context:
+            metadata = {k: v for k, v in (grpc_ctx.invocation_metadata() or ())}
+            ctx = Context(request=GRPCRequest(payload, metadata, full_method),
+                          container=self.container)
+            return ctx, metadata
+
+        async def call_unary(request_bytes_decoded, grpc_ctx):
+            start = time.perf_counter()
+            ctx, metadata = make_ctx(request_bytes_decoded, grpc_ctx)
+            span = tracer.start_span(full_method,
+                                     traceparent=metadata.get("traceparent"))
+            try:
+                result = spec.fn(service, ctx, request_bytes_decoded)
+                if hasattr(result, "__await__"):
+                    result = await result
+                observe(start, "OK")
+                return result
+            except asyncio.CancelledError:
+                observe(start, "CANCELLED")
+                raise
+            except Exception as exc:  # recovery interceptor (grpc.go:98)
+                logger.error(f"grpc panic in {full_method}: {exc!r}",
+                             stack=traceback.format_exc())
+                observe(start, "INTERNAL")
+                await grpc_ctx.abort(grpc.StatusCode.INTERNAL,
+                                     str(exc) or "internal error")
+            finally:
+                span.end()
+
+        async def call_stream(request_decoded, grpc_ctx):
+            start = time.perf_counter()
+            ctx, metadata = make_ctx(request_decoded, grpc_ctx)
+            span = tracer.start_span(full_method,
+                                     traceparent=metadata.get("traceparent"))
+            try:
+                async for item in spec.fn(service, ctx, request_decoded):
+                    yield item
+                observe(start, "OK")
+            except asyncio.CancelledError:
+                observe(start, "CANCELLED")
+                raise
+            except Exception as exc:
+                logger.error(f"grpc panic in {full_method}: {exc!r}",
+                             stack=traceback.format_exc())
+                observe(start, "INTERNAL")
+                await grpc_ctx.abort(grpc.StatusCode.INTERNAL,
+                                     str(exc) or "internal error")
+            finally:
+                span.end()
+
+        return call_unary if spec.kind in (UNARY, CLIENT_STREAM) \
+            else call_stream
+
+    def _handler_for(self, service: GRPCService, spec: RPCSpec):
+        behavior = self._observed(service, spec)
+        kw = {"request_deserializer": spec.request_deserializer,
+              "response_serializer": spec.response_serializer}
+        if spec.kind == UNARY:
+            return grpc.unary_unary_rpc_method_handler(behavior, **kw)
+        if spec.kind == SERVER_STREAM:
+            return grpc.unary_stream_rpc_method_handler(behavior, **kw)
+        if spec.kind == CLIENT_STREAM:
+            return grpc.stream_unary_rpc_method_handler(behavior, **kw)
+        return grpc.stream_stream_rpc_method_handler(behavior, **kw)
+
+    # ------------------------------------------------------------- health
+    def _health_handlers(self):
+        state = self.health
+        container = self.container
+
+        def overall() -> int:
+            try:
+                return SERVING if container.health()["status"] != "DOWN" \
+                    else NOT_SERVING
+            except Exception:
+                return NOT_SERVING
+
+        async def check(service_name: str, grpc_ctx) -> int:
+            if service_name == "":
+                return overall()
+            return state.check(service_name)
+
+        async def watch(service_name: str, grpc_ctx) -> AsyncIterator[int]:
+            yield await check(service_name, grpc_ctx)
+            # hold the stream open; new statuses are pushed on change in
+            # richer implementations — polling keeps this simple
+            while not grpc_ctx.cancelled():
+                await asyncio.sleep(1.0)
+                yield await check(service_name, grpc_ctx)
+
+        kw = {"request_deserializer": decode_check_request,
+              "response_serializer": encode_check_response}
+        return grpc.method_handlers_generic_handler(
+            "grpc.health.v1.Health",
+            {"Check": grpc.unary_unary_rpc_method_handler(check, **kw),
+             "Watch": grpc.unary_stream_rpc_method_handler(watch, **kw)})
+
+    # ---------------------------------------------------------- lifecycle
+    async def start(self) -> None:
+        self._server = grpc.aio.server()
+        for service in self._services:
+            handlers = {spec.name: self._handler_for(service, spec)
+                        for spec in service.rpc_specs()}
+            self._server.add_generic_rpc_handlers(
+                (grpc.method_handlers_generic_handler(service.name,
+                                                      handlers),))
+        self._server.add_generic_rpc_handlers((self._health_handlers(),))
+        self.bound_port = self._server.add_insecure_port(
+            f"0.0.0.0:{self.port}")
+        await self._server.start()
+        self.logger.info(f"gRPC server listening on 0.0.0.0:{self.bound_port}")
+
+    async def shutdown(self, grace: float = 5.0) -> None:
+        if self._server is not None:
+            await self._server.stop(grace)
+            self._server = None

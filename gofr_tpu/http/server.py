@@ -169,13 +169,11 @@ class HTTPServer:
     """Owns the listen socket and the per-connection loop."""
 
     def __init__(self, handler: Handler, *, host: str = "0.0.0.0", port: int = 8000,
-                 logger=None, upgrade_handler=None) -> None:
+                 logger=None) -> None:
         self.handler = handler
         self.host = host
         self.port = port
         self.logger = logger
-        # async (request, reader, writer) -> bool: True if it took over the conn
-        self.upgrade_handler = upgrade_handler
         self._server: asyncio.AbstractServer | None = None
 
     async def start(self) -> None:
@@ -224,13 +222,12 @@ class HTTPServer:
                 if request is None:
                     break
 
-                if (self.upgrade_handler is not None
-                        and "upgrade" in request.headers.get("connection", "").lower()):
-                    took_over = await self.upgrade_handler(request, reader, writer)
-                    if took_over:
-                        # the upgrade handler (or a task it spawned) now owns
-                        # reader/writer; do not close them here
-                        return
+                if "upgrade" in request.headers.get("connection", "").lower():
+                    # hand the raw socket to the chain: the innermost
+                    # websocket middleware performs the handshake AFTER
+                    # every other middleware (auth included) has passed
+                    request.ws_reader = reader
+                    request.ws_writer = writer
                 try:
                     response = await self.handler(request)
                 except Exception as exc:  # middleware failed catastrophically
@@ -239,6 +236,11 @@ class HTTPServer:
                     response = ResponseData(
                         status=500,
                         body=b'{"error": {"message": "internal server error"}}')
+                if getattr(response, "hijacked", False):
+                    # a websocket message loop now owns reader/writer;
+                    # do not write a response or close the socket
+                    took_over = True
+                    return
                 keep_alive = request.headers.get("connection", "").lower() != "close"
                 try:
                     await write_response(writer, response,

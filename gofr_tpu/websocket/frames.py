@@ -55,6 +55,17 @@ class Frame:
     fin: bool = True
 
 
+def apply_mask(data: bytes, key: bytes) -> bytes:
+    """XOR-mask via one big-int op (~100x faster than a per-byte loop;
+    frames can be 16 MB and this runs on the event-loop thread)."""
+    if not data:
+        return data
+    n = len(data)
+    full_key = (key * ((n + 3) // 4))[:n]
+    return (int.from_bytes(data, "little")
+            ^ int.from_bytes(full_key, "little")).to_bytes(n, "little")
+
+
 def encode_frame(opcode: int, payload: bytes, *, fin: bool = True,
                  mask: bool = False) -> bytes:
     head = bytearray()
@@ -72,7 +83,7 @@ def encode_frame(opcode: int, payload: bytes, *, fin: bool = True,
     if mask:
         key = os.urandom(4)
         head += key
-        payload = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
+        payload = apply_mask(payload, key)
     return bytes(head) + payload
 
 
@@ -109,7 +120,7 @@ async def read_frame(reader: asyncio.StreamReader, *,
     except (asyncio.IncompleteReadError, ConnectionResetError):
         return None
     if masked and payload:
-        payload = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
+        payload = apply_mask(payload, key)
     return Frame(opcode=opcode, payload=payload, fin=fin)
 
 

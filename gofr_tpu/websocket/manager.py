@@ -57,11 +57,12 @@ class WSManager:
                    if key not in skip and not conn.closed]
 
         async def one(conn: WSConnection) -> bool:
+            # CancelledError deliberately NOT caught: cancelling the
+            # broadcasting task must unwind it, not be counted as a miss
             try:
                 await asyncio.wait_for(conn.send(data), self.SEND_TIMEOUT)
                 return True
-            except (ConnectionError, RuntimeError, asyncio.TimeoutError,
-                    asyncio.CancelledError):
+            except (ConnectionError, RuntimeError, asyncio.TimeoutError):
                 return False
 
         results = await asyncio.gather(*(one(c) for c in targets))

@@ -1,14 +1,17 @@
-"""Server-side websocket runtime: upgrade handshake + message loop.
+"""Server-side websocket runtime: upgrade middleware + message loop.
 
-The glue between the HTTP server's upgrade hook and user handlers —
-reference pkg/gofr/websocket.go:30-49 (App.WebSocket registers a GET
-route whose handler loop calls the user Handler per message, with
-``ctx.bind`` reading a frame) and middleware/web_socket.go:14-37
-(upgrade + Manager registration keyed by Sec-WebSocket-Key).
+The glue between the HTTP server and user handlers — reference
+pkg/gofr/websocket.go:30-49 (App.WebSocket registers a route whose
+handler loop calls the user Handler per message, with ``ctx.bind``
+reading a frame) and middleware/web_socket.go:14-37 (upgrade + Manager
+registration keyed by Sec-WebSocket-Key).
 
-Auth: installed auth providers run BEFORE the handshake, so protected
-apps never serve anonymous websockets (the upgrade path cannot bypass
-the middleware chain).
+The upgrade is the INNERMOST middleware — exactly the reference's
+ordering (http_server.go:36-41: trace → log → CORS → metrics → auth →
+WS upgrade) — so every installed middleware, including user middleware
+and auth, runs before the handshake. A successful handshake returns a
+101 response marked ``hijacked``: the server then leaves the socket to
+the message loop.
 """
 
 from __future__ import annotations
@@ -17,8 +20,8 @@ import asyncio
 import json
 from typing import Any, Mapping
 
-from ..http.auth import is_exempt, run_provider
 from ..http.request import HTTPRequest, bind_dataclass
+from ..http.responder import ResponseData
 from .connection import WSConnection, WSMessage
 from .frames import accept_key
 
@@ -80,56 +83,54 @@ def _looks_like_json(text: str) -> bool:
         or stripped[:1].isdigit() or stripped[:1] == "-"
 
 
-def make_upgrade_handler(ws_router, container, auth_providers,
-                         logger) -> Any:
-    """Build the server's upgrade hook:
-    async (request, reader, writer) -> took_over."""
+def make_ws_middleware(ws_router, container, logger):
+    """The innermost middleware: performs the RFC 6455 handshake for
+    matching requests that made it through the rest of the chain."""
 
-    async def upgrade(request: HTTPRequest, reader: asyncio.StreamReader,
-                      writer: asyncio.StreamWriter) -> bool:
-        matched = ws_router.match("WS", request.path)
-        if matched is None:
-            return False  # not a WS route; normal chain answers
-        if request.headers.get("upgrade", "").lower() != "websocket":
-            return False
-        key = request.headers.get("sec-websocket-key", "")
-        if not key:
-            return False  # malformed; GET route answers 400/426
-        if request.headers.get("sec-websocket-version", "") != "13":
-            # RFC 6455 4.2.2: advertise the version we speak
-            writer.write(b"HTTP/1.1 426 Upgrade Required\r\n"
-                         b"Sec-WebSocket-Version: 13\r\n"
-                         b"Connection: close\r\n"
-                         b"Content-Length: 0\r\n\r\n")
+    def mw(next_handler):
+        async def wrapped(request: HTTPRequest) -> ResponseData:
+            if request.headers.get("upgrade", "").lower() != "websocket":
+                return await next_handler(request)
+            matched = ws_router.match("WS", request.path)
+            if matched is None:
+                return await next_handler(request)
+            writer = getattr(request, "ws_writer", None)
+            reader = getattr(request, "ws_reader", None)
+            if writer is None or reader is None:
+                # transport that can't hand over the socket (tests
+                # calling the chain directly): plain route answers
+                return await next_handler(request)
+
+            key = request.headers.get("sec-websocket-key", "")
+            if not key:
+                return await next_handler(request)  # route answers 426
+            if request.headers.get("sec-websocket-version", "") != "13":
+                # RFC 6455 4.2.2: advertise the version we speak
+                return ResponseData(
+                    status=426, body=b"",
+                    headers={"Sec-WebSocket-Version": "13"})
+
+            route, path_params = matched
+            headers = ["HTTP/1.1 101 Switching Protocols",
+                       "Upgrade: websocket", "Connection: Upgrade",
+                       f"Sec-WebSocket-Accept: {accept_key(key)}"]
+            writer.write(("\r\n".join(headers) + "\r\n\r\n").encode())
             await writer.drain()
-            writer.close()
-            return True
 
-        # auth runs BEFORE the handshake (same provider semantics as the
-        # middleware chain); on failure fall through to the normal chain,
-        # which produces the 401
-        if not is_exempt(request.path):
-            for provider in auth_providers:
-                if not await run_provider(provider, request):
-                    return False
+            conn = WSConnection(reader, writer, conn_id=key)
+            if container.ws_manager is not None:
+                container.ws_manager.add(key, conn)
+            task = asyncio.ensure_future(_message_loop(
+                route.handler, request, conn, path_params, container,
+                logger))
+            _LOOP_TASKS.add(task)
+            task.add_done_callback(_LOOP_TASKS.discard)
 
-        route, path_params = matched
-        headers = ["HTTP/1.1 101 Switching Protocols", "Upgrade: websocket",
-                   "Connection: Upgrade",
-                   f"Sec-WebSocket-Accept: {accept_key(key)}"]
-        writer.write(("\r\n".join(headers) + "\r\n\r\n").encode())
-        await writer.drain()
-
-        conn = WSConnection(reader, writer, conn_id=key)
-        if container.ws_manager is not None:
-            container.ws_manager.add(key, conn)
-        task = asyncio.ensure_future(_message_loop(
-            route.handler, request, conn, path_params, container, logger))
-        _LOOP_TASKS.add(task)
-        task.add_done_callback(_LOOP_TASKS.discard)
-        return True
-
-    return upgrade
+            response = ResponseData(status=101, body=b"")
+            response.hijacked = True  # server: don't write, don't close
+            return response
+        return wrapped
+    return mw
 
 
 async def _message_loop(handler, upgrade_request: HTTPRequest,
@@ -157,12 +158,17 @@ async def _message_loop(handler, upgrade_request: HTTPRequest,
                     await conn.send(result)
             except (ConnectionError, asyncio.CancelledError):
                 raise
-            except Exception as exc:  # handler panic: log, keep the conn
+            except Exception as exc:  # panic recovery: keep the conn
                 logger.error(f"ws handler error on {upgrade_request.path}: "
                              f"{exc!r}")
+                # mirror the HTTP panic policy (handler.go:141): only
+                # errors that declare a status/message are client-visible
+                if hasattr(exc, "status_code"):
+                    visible = str(exc) or exc.__class__.__name__
+                else:
+                    visible = "internal server error"
                 try:
-                    await conn.send({"error": str(exc) or
-                                     exc.__class__.__name__})
+                    await conn.send({"error": visible})
                 except (ConnectionError, RuntimeError):
                     break
     except (ConnectionError, asyncio.CancelledError):

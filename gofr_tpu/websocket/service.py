@@ -37,28 +37,31 @@ async def connect(url: str, *, headers: dict[str, str] | None = None,
         asyncio.open_connection(host, port, ssl=ssl_ctx), timeout)
 
     key = base64.b64encode(os.urandom(16)).decode()
-    lines = [f"GET {path} HTTP/1.1", f"Host: {host}:{port}",
-             "Upgrade: websocket", "Connection: Upgrade",
-             f"Sec-WebSocket-Key: {key}", "Sec-WebSocket-Version: 13"]
-    for k, v in (headers or {}).items():
-        lines.append(f"{k}: {v}")
-    writer.write(("\r\n".join(lines) + "\r\n\r\n").encode())
-    await writer.drain()
+    try:
+        lines = [f"GET {path} HTTP/1.1", f"Host: {host}:{port}",
+                 "Upgrade: websocket", "Connection: Upgrade",
+                 f"Sec-WebSocket-Key: {key}", "Sec-WebSocket-Version: 13"]
+        for k, v in (headers or {}).items():
+            lines.append(f"{k}: {v}")
+        writer.write(("\r\n".join(lines) + "\r\n\r\n").encode())
+        await writer.drain()
 
-    head = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"), timeout)
-    response_lines = head.decode("latin-1").split("\r\n")
-    status_parts = response_lines[0].split(" ", 2)
-    if len(status_parts) < 2 or status_parts[1] != "101":
+        head = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"), timeout)
+        response_lines = head.decode("latin-1").split("\r\n")
+        status_parts = response_lines[0].split(" ", 2)
+        if len(status_parts) < 2 or status_parts[1] != "101":
+            raise WSHandshakeError(
+                f"handshake rejected: {response_lines[0]}")
+        response_headers = {}
+        for line in response_lines[1:]:
+            if ":" in line:
+                k, _, v = line.partition(":")
+                response_headers[k.strip().lower()] = v.strip()
+        if response_headers.get("sec-websocket-accept") != accept_key(key):
+            raise WSHandshakeError("bad Sec-WebSocket-Accept")
+    except BaseException:  # incl. TimeoutError: never leak the socket
         writer.close()
-        raise WSHandshakeError(f"handshake rejected: {response_lines[0]}")
-    response_headers = {}
-    for line in response_lines[1:]:
-        if ":" in line:
-            k, _, v = line.partition(":")
-            response_headers[k.strip().lower()] = v.strip()
-    if response_headers.get("sec-websocket-accept") != accept_key(key):
-        writer.close()
-        raise WSHandshakeError("bad Sec-WebSocket-Accept")
+        raise
     return WSConnection(reader, writer, is_client=True, conn_id=key)
 
 

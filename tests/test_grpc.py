@@ -1,0 +1,201 @@
+"""gRPC transport: unary/streaming RPCs, health, observability, errors."""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+import grpc as grpc_lib
+import pytest
+
+from gofr_tpu.grpc import (
+    GRPCClient,
+    GRPCService,
+    bidi_stream_rpc,
+    client_stream_rpc,
+    rpc,
+    server_stream_rpc,
+)
+
+from .apputil import AppRunner
+
+
+@dataclass
+class Greeting:
+    name: str
+    excited: bool = False
+
+
+class GreeterService(GRPCService):
+    name = "gofr.test.Greeter"
+
+    @rpc
+    def SayHello(self, ctx, request):
+        greeting = ctx.bind(Greeting)
+        suffix = "!" if greeting.excited else "."
+        return {"message": f"hello {greeting.name}{suffix}"}
+
+    @rpc
+    def WhoAmI(self, ctx, request):
+        # container injection: config reachable from the service handler
+        return {"app": self.container.app_name,
+                "metadata_probe": ctx.param("x-probe")}
+
+    @rpc
+    def Boom(self, ctx, request):
+        raise RuntimeError("kaboom")
+
+    @server_stream_rpc
+    async def CountTo(self, ctx, request):
+        for i in range(int(request["n"])):
+            yield {"i": i}
+
+    @client_stream_rpc
+    async def Sum(self, ctx, request_iterator):
+        total = 0
+        async for item in request_iterator:
+            total += item["x"]
+        return {"total": total}
+
+    @bidi_stream_rpc
+    async def EchoAll(self, ctx, request_iterator):
+        async for item in request_iterator:
+            yield {"echo": item}
+
+
+def build(app):
+    app.register_grpc_service(GreeterService())
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 20))
+
+
+def grpc_runner() -> AppRunner:
+    return AppRunner(build=build, config={"GRPC_PORT": "0"})
+
+
+class TestGRPC:
+    def test_unary_and_dataclass_bind(self):
+        with grpc_runner() as r:
+            port = r.app.grpc_server.bound_port
+
+            async def go():
+                client = GRPCClient(f"127.0.0.1:{port}")
+                reply = await client.call("gofr.test.Greeter", "SayHello",
+                                          {"name": "ada", "excited": True})
+                assert reply == {"message": "hello ada!"}
+                await client.close()
+            run(go())
+
+    def test_container_injection_and_metadata(self):
+        with grpc_runner() as r:
+            port = r.app.grpc_server.bound_port
+
+            async def go():
+                channel = grpc_lib.aio.insecure_channel(f"127.0.0.1:{port}")
+                method = channel.unary_unary(
+                    "/gofr.test.Greeter/WhoAmI",
+                    request_serializer=lambda o: b"{}",
+                    response_deserializer=lambda b: __import__("json").loads(b))
+                reply = await method({}, metadata=(("x-probe", "42"),))
+                assert reply["app"] == "test-app"
+                assert reply["metadata_probe"] == "42"
+                await channel.close()
+            run(go())
+
+    def test_server_streaming(self):
+        with grpc_runner() as r:
+            port = r.app.grpc_server.bound_port
+
+            async def go():
+                client = GRPCClient(f"127.0.0.1:{port}")
+                got = [item async for item in
+                       client.stream("gofr.test.Greeter", "CountTo", {"n": 4})]
+                assert got == [{"i": 0}, {"i": 1}, {"i": 2}, {"i": 3}]
+                await client.close()
+            run(go())
+
+    def test_client_stream_and_bidi(self):
+        with grpc_runner() as r:
+            port = r.app.grpc_server.bound_port
+
+            async def go():
+                import json
+                channel = grpc_lib.aio.insecure_channel(f"127.0.0.1:{port}")
+                sum_rpc = channel.stream_unary(
+                    "/gofr.test.Greeter/Sum",
+                    request_serializer=lambda o: json.dumps(o).encode(),
+                    response_deserializer=lambda b: json.loads(b))
+
+                async def gen():
+                    for x in (1, 2, 3):
+                        yield {"x": x}
+                reply = await sum_rpc(gen())
+                assert reply == {"total": 6}
+
+                bidi = channel.stream_stream(
+                    "/gofr.test.Greeter/EchoAll",
+                    request_serializer=lambda o: json.dumps(o).encode(),
+                    response_deserializer=lambda b: json.loads(b))
+                call = bidi(gen())
+                got = [item async for item in call]
+                assert [g["echo"]["x"] for g in got] == [1, 2, 3]
+                await channel.close()
+            run(go())
+
+    def test_handler_error_maps_to_internal(self):
+        with grpc_runner() as r:
+            port = r.app.grpc_server.bound_port
+
+            async def go():
+                client = GRPCClient(f"127.0.0.1:{port}")
+                with pytest.raises(grpc_lib.aio.AioRpcError) as err:
+                    await client.call("gofr.test.Greeter", "Boom", {})
+                assert err.value.code() == grpc_lib.StatusCode.INTERNAL
+                assert "kaboom" in err.value.details()
+                await client.close()
+            run(go())
+
+    def test_unknown_method_unimplemented(self):
+        with grpc_runner() as r:
+            port = r.app.grpc_server.bound_port
+
+            async def go():
+                client = GRPCClient(f"127.0.0.1:{port}")
+                with pytest.raises(grpc_lib.aio.AioRpcError) as err:
+                    await client.call("gofr.test.Greeter", "Nope", {})
+                assert err.value.code() == grpc_lib.StatusCode.UNIMPLEMENTED
+                await client.close()
+            run(go())
+
+    def test_standard_health_protocol(self):
+        with grpc_runner() as r:
+            port = r.app.grpc_server.bound_port
+
+            async def go():
+                client = GRPCClient(f"127.0.0.1:{port}")
+                assert await client.health_check() == "SERVING"
+                assert await client.health_check("gofr.test.Greeter") == \
+                    "SERVING"
+                assert await client.health_check("no.such.Service") == \
+                    "SERVICE_UNKNOWN"
+                await client.close()
+            run(go())
+
+    def test_metrics_recorded(self):
+        with grpc_runner() as r:
+            port = r.app.grpc_server.bound_port
+
+            async def go():
+                client = GRPCClient(f"127.0.0.1:{port}")
+                await client.call("gofr.test.Greeter", "SayHello",
+                                  {"name": "x"})
+                await client.close()
+            run(go())
+            status, _, data = r.request("GET", "/metrics",
+                                        port=r.metrics_port)
+            assert status == 200
+            text = data.decode()
+            assert "app_grpc_server_duration" in text
+            assert "SayHello" in text

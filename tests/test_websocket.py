@@ -91,7 +91,8 @@ class TestWebSocket:
                 await conn.send("x")
                 import json
                 reply = json.loads((await conn.recv()).text())
-                assert "error" in reply
+                # internal details are masked (HTTP panic-recovery policy)
+                assert reply == {"error": "internal server error"}
                 # connection survives; next message also answered
                 await conn.send("y")
                 assert (await conn.recv()) is not None
@@ -171,6 +172,32 @@ class TestWebSocketAuth:
                 conn = await connect(
                     f"ws://127.0.0.1:{r.port}/ws/echo",
                     headers={"Authorization": f"Basic {token}"})
+                await conn.send("hi")
+                assert (await conn.recv()) is not None
+                await conn.close()
+            run(go())
+
+
+class TestUserMiddlewareGuardsUpgrade:
+    def test_user_middleware_runs_before_handshake(self):
+        """The upgrade is innermost: custom middleware can veto it."""
+        def build(app):
+            build_echo(app)
+
+            def deny_mw(next_handler):
+                async def wrapped(request):
+                    if request.header("x-tenant") != "good":
+                        from gofr_tpu.http.responder import ResponseData
+                        return ResponseData(status=403, body=b"denied")
+                    return await next_handler(request)
+                return wrapped
+            app.use_middleware(deny_mw)
+        with AppRunner(build=build) as r:
+            async def go():
+                with pytest.raises(WSHandshakeError, match="403"):
+                    await connect(f"ws://127.0.0.1:{r.port}/ws/echo")
+                conn = await connect(f"ws://127.0.0.1:{r.port}/ws/echo",
+                                     headers={"X-Tenant": "good"})
                 await conn.send("hi")
                 assert (await conn.recv()) is not None
                 await conn.close()
