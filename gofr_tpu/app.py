@@ -111,6 +111,11 @@ class App:
     def add_static_files(self, url_prefix: str, directory: str) -> None:
         self.router.add_static(url_prefix, directory)
 
+    def add_rest_handlers(self, entity_cls: type, **kwargs):
+        """Auto-CRUD for a dataclass entity (reference rest.go:53)."""
+        from .crud import add_rest_handlers
+        return add_rest_handlers(self, entity_cls, **kwargs)
+
     def use_middleware(self, middleware: Callable) -> None:
         """Append a user middleware (runs innermost, after the chain)."""
         self._user_middlewares.append(middleware)

@@ -1,0 +1,8 @@
+"""CLI runtime: subcommand apps + terminal TUI toolkit."""
+
+from .cmd import CMDApp
+from .request import CMDRequest, parse_args
+from .terminal import Out, ProgressBar, Spinner
+
+__all__ = ["CMDApp", "CMDRequest", "parse_args", "Out", "Spinner",
+           "ProgressBar"]

@@ -97,9 +97,18 @@ class Tx:
     def query(self, query: str, *args: Any) -> list[sqlite3.Row]:
         return self._db.query(query, *args)
 
+    def query_row(self, query: str, *args: Any) -> sqlite3.Row | None:
+        return self._db.query_row(query, *args)
+
     def exec(self, query: str, *args: Any) -> sqlite3.Cursor:
         # no per-statement commit: begin() commits/rolls back the batch
         return self._db._execute(query, args, commit=False)
+
+    def ph(self, n: int) -> str:
+        return self._db.ph(n)
+
+    def select(self, entity_type: type, query: str, *args: Any) -> list[Any]:
+        return self._db.select(entity_type, query, *args)
 
 
 class SQL(ProviderMixin):
@@ -123,8 +132,13 @@ class SQL(ProviderMixin):
             raise SQLError(
                 f"no driver for dialect {self.dialect!r} in this build; "
                 "sqlite is the shipped backend")
+        # isolation_level=None -> true autocommit; begin() issues an
+        # explicit BEGIN so DDL rides the transaction too (sqlite's
+        # legacy implicit-BEGIN mode auto-commits DDL, which would make
+        # "transactional migrations" silently non-transactional)
         self._conn = sqlite3.connect(self.database,
-                                     check_same_thread=False)
+                                     check_same_thread=False,
+                                     isolation_level=None)
         self._conn.row_factory = sqlite3.Row
         if self.logger is not None:
             self.logger.info("connected to SQL",
@@ -139,6 +153,10 @@ class SQL(ProviderMixin):
             self.metrics.record_histogram("app_sql_stats", duration_us / 1e6,
                                           type=query.split(None, 1)[0].lower()
                                           if query.split() else "unknown")
+
+    def ph(self, n: int) -> str:
+        """The n-th (1-based) bind placeholder for this dialect."""
+        return placeholder(self.dialect, n)
 
     def _require_conn(self) -> sqlite3.Connection:
         if self._conn is None:
@@ -204,11 +222,12 @@ class SQL(ProviderMixin):
             token = object()
             self._tx_token = token
             ctx_token = _CURRENT_TX.set(token)
+            conn.execute("BEGIN IMMEDIATE")
             try:
                 yield Tx(self)
-                conn.commit()
+                conn.execute("COMMIT")
             except BaseException:
-                conn.rollback()
+                conn.execute("ROLLBACK")
                 raise
             finally:
                 self._tx_token = None

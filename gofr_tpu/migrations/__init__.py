@@ -1,0 +1,5 @@
+"""Versioned datasource migrations with per-store ledgers."""
+
+from .runner import Datasource, Migrate, MigrationError, run
+
+__all__ = ["Migrate", "Datasource", "MigrationError", "run"]

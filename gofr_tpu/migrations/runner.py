@@ -1,0 +1,154 @@
+"""Versioned migrations over every initialized datasource.
+
+Mirrors reference pkg/gofr/migration/: user supplies
+``{version: Migrate(up=fn)}`` (migration.go:14-18); ``run`` sorts
+versions, builds a migrator chain over whichever datasources are
+initialized (migration.go:118-235), ensures the ``gofr_migrations``
+ledger in each store, and applies every version newer than the last
+recorded one — SQL transactionally with rollback on failure
+(migration.go:59-98). Each migration's ``up`` receives a ``Datasource``
+facade so one migration can touch SQL, Redis, KV, and pub/sub topics.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+
+@dataclass
+class Migrate:
+    up: Callable[["Datasource"], None]
+
+
+class Datasource:
+    """What a migration's ``up`` sees (reference migration/datasource.go):
+    the initialized stores plus the logger. Inside ``run`` the SQL
+    handle is the open transaction."""
+
+    def __init__(self, *, sql: Any = None, redis: Any = None, kv: Any = None,
+                 pubsub: Any = None, logger: Any = None) -> None:
+        self.sql = sql
+        self.redis = redis
+        self.kv = kv
+        self.pubsub = pubsub
+        self.logger = logger
+
+
+class MigrationError(Exception):
+    pass
+
+
+LEDGER_TABLE = "gofr_migrations"
+LEDGER_PREFIX = "gofr_migrations:"
+
+
+class _SQLMigrator:
+    def __init__(self, sql: Any) -> None:
+        self.sql = sql
+
+    def ensure_ledger(self) -> None:
+        self.sql.exec(
+            f"CREATE TABLE IF NOT EXISTS {LEDGER_TABLE} ("
+            "version INTEGER PRIMARY KEY, method TEXT NOT NULL, "
+            "start_time TEXT NOT NULL, duration_ms INTEGER)")
+
+    def last_version(self) -> int:
+        row = self.sql.query_row(
+            f"SELECT MAX(version) AS v FROM {LEDGER_TABLE}")
+        return int(row["v"]) if row is not None and row["v"] is not None else 0
+
+    def record(self, tx: Any, version: int, started: float) -> None:
+        tx.exec(
+            f"INSERT INTO {LEDGER_TABLE} "
+            "(version, method, start_time, duration_ms) VALUES "
+            f"({self.sql.ph(1)}, {self.sql.ph(2)}, {self.sql.ph(3)}, "
+            f"{self.sql.ph(4)})",
+            version, "UP",
+            time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(started)),
+            int((time.time() - started) * 1000))
+
+
+class _KVStyleMigrator:
+    """Redis- and KV-backed ledger: one key per version."""
+
+    def __init__(self, store: Any) -> None:
+        self.store = store
+
+    def ensure_ledger(self) -> None:
+        pass  # key space needs no DDL
+
+    def last_version(self) -> int:
+        try:
+            keys = self.store.keys()
+        except TypeError:  # redis-style keys(pattern)
+            keys = self.store.keys(LEDGER_PREFIX + "*")
+        versions = []
+        for key in keys:
+            if key.startswith(LEDGER_PREFIX):
+                try:
+                    versions.append(int(key[len(LEDGER_PREFIX):]))
+                except ValueError:
+                    continue
+        return max(versions, default=0)
+
+    def record(self, version: int, started: float) -> None:
+        self.store.set(f"{LEDGER_PREFIX}{version}", json.dumps({
+            "method": "UP",
+            "start_time": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                        time.gmtime(started)),
+            "duration_ms": int((time.time() - started) * 1000)}))
+
+
+def run(container: Any, migrations: dict[int, Any]) -> list[int]:
+    """Apply pending migrations; returns the versions that ran
+    (reference migration.Run, migration.go:29-99)."""
+    logger = container.logger
+    if not migrations:
+        return []
+    for version, migration in migrations.items():
+        if not isinstance(version, int) or version <= 0:
+            raise MigrationError(f"invalid migration version {version!r}")
+        if not callable(getattr(migration, "up", None)):
+            raise MigrationError(f"migration {version} has no callable 'up'")
+
+    sql_migrator = _SQLMigrator(container.sql) if container.sql else None
+    kv_migrators = [_KVStyleMigrator(store)
+                    for store in (container.redis, container.kv) if store]
+    if sql_migrator is None and not kv_migrators:
+        raise MigrationError(
+            "no datasource initialized to track migrations against")
+
+    if sql_migrator:
+        sql_migrator.ensure_ledger()
+    lasts = ([sql_migrator.last_version()] if sql_migrator else []) + \
+        [m.last_version() for m in kv_migrators]
+    last = max(lasts)
+
+    applied: list[int] = []
+    for version in sorted(migrations):
+        if version <= last:
+            continue
+        started = time.time()
+        migration = migrations[version]
+        if sql_migrator is not None:
+            # transactional: the migration's SQL rides the tx and rolls
+            # back with the ledger row on failure (migration.go:68-97)
+            with container.sql.begin() as tx:
+                ds = Datasource(sql=tx, redis=container.redis,
+                                kv=container.kv, pubsub=container.pubsub,
+                                logger=logger)
+                migration.up(ds)
+                sql_migrator.record(tx, version, started)
+        else:
+            ds = Datasource(redis=container.redis, kv=container.kv,
+                            pubsub=container.pubsub, logger=logger)
+            migration.up(ds)
+        for migrator in kv_migrators:
+            migrator.record(version, started)
+        applied.append(version)
+        logger.info(f"migration {version} applied in "
+                    f"{int((time.time() - started) * 1000)}ms")
+    return applied

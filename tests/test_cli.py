@@ -1,0 +1,181 @@
+"""CLI runtime: parsing, routing, binding, help, terminal widgets."""
+
+from __future__ import annotations
+
+import io
+from dataclasses import dataclass
+
+from gofr_tpu.cli import CMDApp, Out, parse_args
+from gofr_tpu.cli.request import CMDRequest
+from gofr_tpu.cli.terminal import ProgressBar
+from gofr_tpu.config import DictConfig
+
+
+def make_app() -> tuple[CMDApp, io.StringIO, io.StringIO]:
+    app = CMDApp(config=DictConfig({"APP_NAME": "tool"}))
+    stdout, stderr = io.StringIO(), io.StringIO()
+    app.out = Out(stream=stdout, force_tty=False)
+    app.err_out = Out(stream=stderr, force_tty=False)
+    return app, stdout, stderr
+
+
+class TestParseArgs:
+    def test_forms(self):
+        pos, flags = parse_args(["db", "migrate", "-n=5", "--env", "prod",
+                                 "-v", "--dry-run"])
+        assert pos == ["db", "migrate"]
+        assert flags["n"] == ["5"]
+        assert flags["env"] == ["prod"]
+        assert flags["v"] == ["true"]
+        assert flags["dry-run"] == ["true"]
+
+    def test_repeat_and_csv_params(self):
+        request = CMDRequest(["x", "-t=a", "-t=b,c"])
+        assert request.params("t") == ["a", "b", "c"]
+        assert request.param("t") == "a"
+        assert request.param("missing") == ""
+
+
+@dataclass
+class MigrateArgs:
+    env: str
+    n: int = 1
+    dry_run: bool = False
+
+
+class TestCMDApp:
+    def test_routing_and_result_printing(self):
+        app, stdout, _ = make_app()
+        app.sub_command("greet", lambda ctx: f"hello {ctx.param('name')}")
+        code = app.run(["greet", "-name=ada"])
+        assert code == 0
+        assert stdout.getvalue().strip() == "hello ada"
+
+    def test_longest_prefix_wins(self):
+        app, stdout, _ = make_app()
+        app.sub_command("db", lambda ctx: "db root")
+        app.sub_command("db migrate", lambda ctx: "migrating")
+        assert app.run(["db", "migrate"]) == 0
+        assert stdout.getvalue().strip() == "migrating"
+
+    def test_dataclass_bind(self):
+        app, stdout, _ = make_app()
+
+        @app.sub_command("migrate")
+        def migrate(ctx):
+            args = ctx.bind(MigrateArgs)
+            return {"env": args.env, "n": args.n, "dry": args.dry_run}
+        assert app.run(["migrate", "--env=prod", "-n=3"]) == 0
+        out = stdout.getvalue()
+        assert '"env": "prod"' in out and '"n": 3' in out
+
+    def test_dict_result_prints_json(self):
+        app, stdout, _ = make_app()
+        app.sub_command("info", lambda ctx: {"version": 1})
+        app.run(["info"])
+        assert '"version": 1' in stdout.getvalue()
+
+    def test_error_goes_to_stderr_with_exit_code(self):
+        app, stdout, stderr = make_app()
+
+        def boom(ctx):
+            raise ValueError("bad input")
+        app.sub_command("boom", boom)
+        code = app.run(["boom"])
+        assert code == 1
+        assert "bad input" in stderr.getvalue()
+        assert stdout.getvalue() == ""
+
+    def test_async_handler(self):
+        app, stdout, _ = make_app()
+
+        @app.sub_command("async")
+        async def handler(ctx):
+            return "done"
+        assert app.run(["async"]) == 0
+        assert "done" in stdout.getvalue()
+
+    def test_help_listing(self):
+        app, stdout, _ = make_app()
+        app.sub_command("serve", lambda ctx: None,
+                        description="start the server")
+        app.sub_command("migrate", lambda ctx: None,
+                        description="run migrations")
+        assert app.run(["help"]) == 0
+        out = stdout.getvalue()
+        assert "serve" in out and "start the server" in out
+        assert "migrate" in out and "run migrations" in out
+
+    def test_help_flag_on_matched_subcommand(self):
+        app, stdout, _ = make_app()
+        ran = []
+        app.sub_command("greet", lambda ctx: ran.append(1) or "hi",
+                        description="say hello")
+        assert app.run(["greet", "--help"]) == 0
+        assert ran == []  # handler must NOT execute
+        assert "say hello" in stdout.getvalue()
+
+    def test_unknown_command_shows_help_exit_2(self):
+        app, stdout, _ = make_app()
+        app.sub_command("serve", lambda ctx: None, description="x")
+        assert app.run(["nope"]) == 2
+        assert "serve" in stdout.getvalue()
+
+    def test_terminal_attached_to_context(self):
+        app, stdout, _ = make_app()
+
+        @app.sub_command("draw")
+        def draw(ctx):
+            ctx.terminal.print(ctx.terminal.green("ok"))
+            return None
+        assert app.run(["draw"]) == 0
+        assert "ok" in stdout.getvalue()
+
+    def test_container_reachable(self):
+        app, stdout, _ = make_app()
+        app.sub_command("name", lambda ctx: ctx.container.app_name)
+        app.run(["name"])
+        assert "tool" in stdout.getvalue()
+
+
+class TestTerminal:
+    def test_colors_only_on_tty(self):
+        plain = Out(stream=io.StringIO(), force_tty=False)
+        assert plain.green("x") == "x"
+        tty = Out(stream=io.StringIO(), force_tty=True)
+        assert tty.green("x") == "\x1b[32mx\x1b[0m"
+        assert tty.bold("x") == "\x1b[1mx\x1b[0m"
+
+    def test_progress_bar_tty_renders_bar(self):
+        stream = io.StringIO()
+        out = Out(stream=stream, force_tty=True)
+        bar = ProgressBar(out, total=4, width=8)
+        bar.increment()
+        bar.set(4)
+        text = stream.getvalue()
+        assert "25%" in text and "100%" in text and "█" in text
+
+    def test_progress_bar_plain_prints_milestones(self):
+        stream = io.StringIO()
+        out = Out(stream=stream, force_tty=False)
+        bar = ProgressBar(out, total=10, width=8)
+        for _ in range(10):
+            bar.increment()
+        text = stream.getvalue()
+        assert "progress: 100%" in text
+        assert "█" not in text
+
+    def test_spinner_plain_mode(self):
+        stream = io.StringIO()
+        out = Out(stream=stream, force_tty=False)
+        with out.spinner("working"):
+            pass
+        assert "working..." in stream.getvalue()
+
+    def test_spinner_tty_animates(self):
+        stream = io.StringIO()
+        out = Out(stream=stream, force_tty=True)
+        import time
+        with out.spinner("load"):
+            time.sleep(0.2)
+        assert "load" in stream.getvalue()

@@ -1,0 +1,119 @@
+"""Auto-CRUD handlers over a real server + sqlite."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import pytest
+
+from gofr_tpu.crud import scan_entity
+
+from .apputil import AppRunner
+
+
+@dataclass
+class User:
+    id: int
+    name: str
+    email: str = ""
+
+
+@dataclass
+class CustomNamed:
+    uid: int
+    label: str = ""
+
+    @classmethod
+    def table_name(cls) -> str:
+        return "custom_tbl"
+
+    @classmethod
+    def rest_path(cls) -> str:
+        return "custom"
+
+
+class TestScanEntity:
+    def test_first_field_is_pk(self):
+        spec = scan_entity(User)
+        assert spec.primary_key == "id"
+        assert spec.table == "user"
+        assert spec.path == "user"
+        assert spec.fields == ["id", "name", "email"]
+
+    def test_overrides(self):
+        spec = scan_entity(CustomNamed)
+        assert spec.table == "custom_tbl"
+        assert spec.path == "custom"
+
+    def test_rejects_non_dataclass(self):
+        with pytest.raises(TypeError):
+            scan_entity(dict)
+
+    def test_rejects_bad_identifiers(self):
+        @dataclass
+        class Evil:
+            pass
+        Evil.table_name = classmethod(lambda cls: "users; DROP TABLE x")
+        with pytest.raises(Exception):
+            scan_entity(Evil)
+
+
+def build(app):
+    app.container.sql.exec(
+        "CREATE TABLE user (id INTEGER PRIMARY KEY, name TEXT, email TEXT)")
+    app.add_rest_handlers(User)
+
+
+def crud_runner() -> AppRunner:
+    return AppRunner(build=build,
+                     config={"DB_DIALECT": "sqlite", "DB_NAME": ":memory:"})
+
+
+class TestCRUD:
+    def test_create_and_get(self):
+        with crud_runner() as r:
+            status, _, _ = r.request(
+                "POST", "/user",
+                body={"id": 1, "name": "ada", "email": "a@x.io"})
+            assert status == 201
+            status, body = r.get_json("/user/1")
+            assert status == 200
+            assert body["data"] == {"id": 1, "name": "ada",
+                                    "email": "a@x.io"}
+
+    def test_get_all(self):
+        with crud_runner() as r:
+            for i in (1, 2, 3):
+                r.request("POST", "/user", body={"id": i, "name": f"u{i}"})
+            status, body = r.get_json("/user")
+            assert status == 200 and len(body["data"]) == 3
+
+    def test_update(self):
+        with crud_runner() as r:
+            r.request("POST", "/user", body={"id": 1, "name": "ada"})
+            status, _, _ = r.request(
+                "PUT", "/user/1",
+                body={"id": 1, "name": "lovelace", "email": "l@x.io"})
+            assert status == 200
+            _, body = r.get_json("/user/1")
+            assert body["data"]["name"] == "lovelace"
+
+    def test_delete(self):
+        with crud_runner() as r:
+            r.request("POST", "/user", body={"id": 1, "name": "ada"})
+            status, _, _ = r.request("DELETE", "/user/1")
+            assert status == 204
+            status, _ = r.get_json("/user/1")
+            assert status == 404
+
+    def test_not_found_and_bad_body(self):
+        with crud_runner() as r:
+            status, _ = r.get_json("/user/99")
+            assert status == 404
+            status, _, _ = r.request("PUT", "/user/99",
+                                     body={"id": 99, "name": "x"})
+            assert status == 404
+            status, _, _ = r.request("DELETE", "/user/99")
+            assert status == 404
+            status, _, _ = r.request("POST", "/user", body={"name": "no-pk"})
+            assert status == 400  # missing required field id

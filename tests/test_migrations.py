@@ -1,0 +1,153 @@
+"""Migrations: ledger, ordering, transactional rollback, multi-store."""
+
+from __future__ import annotations
+
+import pytest
+
+from gofr_tpu.config import DictConfig
+from gofr_tpu.container.container import Container
+from gofr_tpu.datasource.kv import InMemoryKV
+from gofr_tpu.datasource.redis import Redis
+from gofr_tpu.datasource.sql import SQL
+from gofr_tpu.migrations import Migrate, MigrationError, run
+
+
+def make_container(*, sql=True, redis=False, kv=False) -> Container:
+    c = Container(config=DictConfig({}))
+    if sql:
+        store = SQL()
+        store.connect()
+        c.sql = store
+    if redis:
+        c.redis = Redis()
+        c.redis.connect()
+    if kv:
+        c.kv = InMemoryKV()
+        c.kv.connect()
+    return c
+
+
+def create_users(ds):
+    ds.sql.exec("CREATE TABLE users (id INTEGER PRIMARY KEY, name TEXT)")
+
+
+def seed_users(ds):
+    ds.sql.exec("INSERT INTO users (id, name) VALUES (1, 'ada')")
+
+
+class TestMigrationRun:
+    def test_applies_in_version_order_and_records_ledger(self):
+        c = make_container()
+        applied = run(c, {
+            20240102: Migrate(up=seed_users),
+            20240101: Migrate(up=create_users),
+        })
+        assert applied == [20240101, 20240102]
+        rows = c.sql.query("SELECT version FROM gofr_migrations ORDER BY version")
+        assert [r["version"] for r in rows] == [20240101, 20240102]
+        assert c.sql.query_row("SELECT name FROM users")["name"] == "ada"
+
+    def test_rerun_is_idempotent(self):
+        c = make_container()
+        migrations = {1: Migrate(up=create_users), 2: Migrate(up=seed_users)}
+        assert run(c, migrations) == [1, 2]
+        assert run(c, migrations) == []  # nothing new
+        migrations[3] = Migrate(
+            up=lambda ds: ds.sql.exec(
+                "INSERT INTO users (id, name) VALUES (2, 'lin')"))
+        assert run(c, migrations) == [3]
+        assert len(c.sql.query("SELECT * FROM users")) == 2
+
+    def test_failure_rolls_back_sql_and_ledger(self):
+        c = make_container()
+        run(c, {1: Migrate(up=create_users)})
+
+        def bad(ds):
+            ds.sql.exec("INSERT INTO users (id, name) VALUES (9, 'ghost')")
+            raise RuntimeError("migration exploded")
+        with pytest.raises(RuntimeError, match="exploded"):
+            run(c, {1: Migrate(up=create_users), 2: Migrate(up=bad)})
+        # neither the row nor the ledger entry survived
+        assert c.sql.query("SELECT * FROM users") == []
+        versions = [r["version"] for r in
+                    c.sql.query("SELECT version FROM gofr_migrations")]
+        assert versions == [1]
+        # and a later fixed run applies cleanly
+        assert run(c, {1: Migrate(up=create_users),
+                       2: Migrate(up=seed_users)}) == [2]
+
+    def test_ddl_also_rolls_back(self):
+        """CREATE TABLE inside a failing migration must not survive
+        (sqlite legacy mode would auto-commit DDL and wedge reruns)."""
+        c = make_container()
+
+        def bad_ddl(ds):
+            ds.sql.exec("CREATE TABLE half_done (id INTEGER)")
+            raise RuntimeError("died after DDL")
+        with pytest.raises(RuntimeError):
+            run(c, {1: Migrate(up=bad_ddl)})
+        row = c.sql.query_row(
+            "SELECT name FROM sqlite_master WHERE name='half_done'")
+        assert row is None
+        # rerun with a fixed migration succeeds (no 'already exists')
+        assert run(c, {1: Migrate(up=create_users)}) == [1]
+
+    def test_select_works_inside_migration(self):
+        from dataclasses import dataclass
+
+        @dataclass
+        class User:
+            id: int
+            name: str
+
+        c = make_container()
+        got = []
+
+        def read_back(ds):
+            ds.sql.exec("INSERT INTO users VALUES (1, 'ada')")
+            got.extend(ds.sql.select(User, "SELECT id, name FROM users"))
+        run(c, {1: Migrate(up=create_users), 2: Migrate(up=read_back)})
+        assert got == [User(id=1, name="ada")]
+
+    def test_kv_and_redis_ledgers(self):
+        c = make_container(sql=False, redis=True, kv=True)
+        ran = []
+        applied = run(c, {
+            1: Migrate(up=lambda ds: ds.kv.set("schema", "v1")),
+            2: Migrate(up=lambda ds: ran.append(2)),
+        })
+        assert applied == [1, 2]
+        assert c.kv.get("schema") == "v1"
+        # both stores recorded both versions
+        assert run(c, {1: Migrate(up=lambda ds: ran.append("again")),
+                       2: Migrate(up=lambda ds: ran.append("again"))}) == []
+        assert "again" not in ran
+
+    def test_validation(self):
+        c = make_container()
+        with pytest.raises(MigrationError, match="invalid migration version"):
+            run(c, {0: Migrate(up=create_users)})
+        with pytest.raises(MigrationError, match="no callable"):
+            run(c, {1: object()})
+
+    def test_no_datasource_errors(self):
+        c = make_container(sql=False)
+        with pytest.raises(MigrationError, match="no datasource"):
+            run(c, {1: Migrate(up=create_users)})
+
+    def test_pubsub_topic_migration(self):
+        from gofr_tpu.pubsub.inmemory import InMemoryBroker
+        c = make_container()
+        c.pubsub = InMemoryBroker()
+        run(c, {1: Migrate(up=lambda ds: ds.pubsub.create_topic("orders"))})
+        assert "orders" in c.pubsub.topics
+
+
+class TestAppMigrate:
+    def test_app_facade(self):
+        from gofr_tpu.app import App
+        app = App(config=DictConfig({"DB_DIALECT": "sqlite",
+                                     "DB_NAME": ":memory:"}))
+        assert app.container.sql is not None
+        app.migrate({1: Migrate(up=create_users)})
+        assert app.container.sql.query("SELECT * FROM users") == []
