@@ -1,9 +1,11 @@
 """CLI argv as a Request: flags become params, positionals route.
 
 Mirrors reference pkg/gofr/cmd/request.go (arg binder) and
-cmd.go:64-89 (parsing): ``-k=v``, ``--k=v``, ``--k v``, bare ``-flag``
+cmd.go:64-89 (parsing): ``-k=v``, ``--k=v``, and bare ``-flag``
 (true), with everything before the first flag treated as the
-subcommand path.
+subcommand path. Values require ``=`` — ``--flag value`` is a bare
+flag plus a stray arg, exactly as in the reference, which keeps
+``tool deploy --verbose prod`` unambiguous.
 """
 
 from __future__ import annotations
@@ -28,9 +30,6 @@ def parse_args(argv: list[str]) -> tuple[list[str], dict[str, list[str]]]:
             if "=" in name:
                 name, _, value = name.partition("=")
                 flags.setdefault(name, []).append(value)
-            elif i + 1 < len(argv) and not argv[i + 1].startswith("-"):
-                flags.setdefault(name, []).append(argv[i + 1])
-                i += 1
             else:
                 flags.setdefault(name, []).append("true")
         elif not seen_flag:
@@ -70,8 +69,10 @@ class CMDRequest:
         return ""
 
     def bind(self, target: Any = None) -> Any:
-        """Flags -> dict or dataclass (the reflection binder analog)."""
-        data: dict[str, Any] = {k: v[0] if len(v) == 1 else v
+        """Flags -> dict or dataclass (the reflection binder analog).
+        Hyphenated flag names map to underscore field names
+        (``--dry-run`` binds ``dry_run``)."""
+        data: dict[str, Any] = {k.replace("-", "_"): v[0] if len(v) == 1 else v
                                 for k, v in self.flags.items()}
         if target is None:
             return data

@@ -28,6 +28,16 @@ STATUS_DEGRADED = "DEGRADED"
 _DATASOURCE_BUCKETS = (0.00005, 0.0001, 0.0005, 0.001, 0.005, 0.01,
                        0.05, 0.1, 0.5, 1, 5, 30)
 
+# stores beyond the core set, each with a generated add_<slot> method
+_BREADTH_SLOTS = ("mongo", "elasticsearch", "solr", "couchbase",
+                  "cassandra", "scylladb", "clickhouse", "oracle",
+                  "dgraph", "arangodb", "surrealdb", "opentsdb",
+                  "influxdb", "dbresolver")
+
+# every slot health() aggregates over and close() tears down
+_DATASOURCE_SLOTS = ("sql", "redis", "kv", "file", "pubsub",
+                     "tpu") + _BREADTH_SLOTS
+
 
 class Container:
     def __init__(self, config=None, logger: Logger | None = None) -> None:
@@ -45,6 +55,11 @@ class Container:
         self.file: Any = None                # file store
         self.ws_manager: Any = None          # websocket connection manager
         self.ws_services: dict[str, Any] = {}  # name -> outbound WSService
+        # breadth datasource slots (reference container.go:43-75 holds one
+        # field per store); _BREADTH_SLOTS is the single definition site —
+        # it also drives the generated add_* methods, health() and close()
+        for slot in _BREADTH_SLOTS:
+            setattr(self, slot, None)
         self.tpu: Any = None                 # TPU device registry / runtime
         self.models: dict[str, Any] = {}     # name -> serving engine
         self._start_time = time.time()
@@ -122,7 +137,7 @@ class Container:
         }
         statuses: list[str] = []
         checks: dict[str, Any] = {}
-        for name in ("sql", "redis", "kv", "file", "pubsub", "tpu"):
+        for name in _DATASOURCE_SLOTS:
             source = getattr(self, name)
             if source is None:
                 continue
@@ -220,7 +235,7 @@ class Container:
         return self.models.get(name)
 
     async def close(self) -> None:
-        for attr in ("sql", "redis", "kv", "file", "pubsub", "tpu"):
+        for attr in _DATASOURCE_SLOTS:
             source = getattr(self, attr)
             closer = getattr(source, "close", None)
             if closer is None:
@@ -231,3 +246,18 @@ class Container:
                     await result
             except Exception as exc:
                 self.logger.warn(f"closing {attr}: {exc}")
+
+
+def _make_adder(slot: str):
+    def add(self: Container, store: Any) -> Any:
+        setattr(self, slot, self._provide(store))
+        return getattr(self, slot)
+    add.__name__ = f"add_{slot}"
+    add.__doc__ = (f"Attach a {slot} store: use_logger → use_metrics → "
+                   f"use_tracer → connect (reference external_db.go).")
+    return add
+
+
+for _slot in _BREADTH_SLOTS:
+    setattr(Container, f"add_{_slot}", _make_adder(_slot))
+del _slot

@@ -80,6 +80,17 @@ class Context:
     def tpu(self):
         return self.container.tpu
 
+    def __getattr__(self, name: str):
+        # breadth datasource slots (mongo, cassandra, dgraph, influxdb,
+        # ...) resolve straight off the container, mirroring how the
+        # reference Context embeds *Container (context.go:18-38)
+        if name.startswith("_"):
+            raise AttributeError(name)
+        container = self.__dict__.get("container")
+        if container is not None and hasattr(container, name):
+            return getattr(container, name)
+        raise AttributeError(name)
+
     def model(self, name: str) -> Any:
         return self.container.get_model(name)
 

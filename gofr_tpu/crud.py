@@ -114,7 +114,11 @@ def add_rest_handlers(app: Any, cls: type, *,
     def update(ctx):
         sql = sql_of(ctx)
         pk_value = _pk(ctx)
-        entity = bind_dataclass(ctx.bind() or {}, spec.cls)
+        # the pk comes from the path, not the body (reference
+        # crud_handlers.go Update); the body may omit it
+        data = dict(ctx.bind() or {})
+        data.setdefault(spec.primary_key, pk_value)
+        entity = bind_dataclass(data, spec.cls)
         non_pk = [f for f in spec.fields if f != spec.primary_key]
         if not non_pk:
             raise ErrorInvalidParam("nothing to update")

@@ -17,8 +17,15 @@ Shipped backends:
   JSON/CSV row readers.
 - :mod:`.dbresolver` — SQL primary/replica router with per-replica
   circuit breakers.
+- :mod:`.document` — document-store family (Mongo/Elasticsearch/Solr/
+  Couchbase-shaped) over one embedded engine.
+- :mod:`.columnar` — CQL/columnar family (Cassandra/ScyllaDB/
+  Clickhouse/Oracle-shaped) over sqlite.
+- :mod:`.graph` — graph family (Dgraph/ArangoDB/SurrealDB-shaped).
+- :mod:`.timeseries` — time-series family (OpenTSDB/InfluxDB-shaped).
 """
 
+import time
 from typing import Any, Protocol
 
 
@@ -55,3 +62,43 @@ class ProviderMixin:
 
     def use_tracer(self, tracer: Any) -> None:
         self.tracer = tracer
+
+
+# 50µs–30s, the reference's datasource latency buckets
+# (container/container.go:339-344)
+DATASOURCE_BUCKETS = (0.00005, 0.0001, 0.0005, 0.001, 0.005, 0.01,
+                      0.05, 0.1, 0.5, 1, 5, 30)
+
+
+class Instrumented(ProviderMixin):
+    """Provider + per-op observation: every operation logs a one-line
+    QueryLog and records into the store's latency histogram, the way
+    every reference datasource does (e.g. sql/db.go:47-60)."""
+
+    #: metric name; subclasses override (registered lazily if missing)
+    metric = "app_datasource_stats"
+    #: short tag used in the log line ("MONGO", "CQL", ...)
+    log_tag = "DS"
+
+    def _observed(self, op: str, detail: str, fn):
+        start = time.perf_counter()
+        try:
+            return fn()
+        finally:
+            micros = int((time.perf_counter() - start) * 1e6)
+            if self.logger is not None:
+                self.logger.debug(
+                    f"{self.log_tag} {micros:6d}µs {op} {detail}")
+            if self.metrics is not None:
+                if self.metrics.get(self.metric) is None:
+                    # concurrent first ops may race to register; the
+                    # loser's MetricsError must not clobber fn's result
+                    try:
+                        self.metrics.new_histogram(
+                            self.metric,
+                            f"{self.log_tag} op time in seconds",
+                            buckets=DATASOURCE_BUCKETS)
+                    except Exception:
+                        pass
+                self.metrics.record_histogram(self.metric, micros / 1e6,
+                                              type=op.lower())

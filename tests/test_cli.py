@@ -21,13 +21,24 @@ def make_app() -> tuple[CMDApp, io.StringIO, io.StringIO]:
 
 class TestParseArgs:
     def test_forms(self):
-        pos, flags = parse_args(["db", "migrate", "-n=5", "--env", "prod",
+        pos, flags = parse_args(["db", "migrate", "-n=5", "--env=prod",
                                  "-v", "--dry-run"])
         assert pos == ["db", "migrate"]
         assert flags["n"] == ["5"]
         assert flags["env"] == ["prod"]
         assert flags["v"] == ["true"]
         assert flags["dry-run"] == ["true"]
+
+    def test_bare_flag_does_not_swallow_positional(self):
+        # `tool greet --help extra`: help stays boolean, extra is a
+        # stray arg — values require `=` (reference cmd.go:64-89)
+        _, flags = parse_args(["greet", "--help", "extra"])
+        assert flags["help"] == ["true"]
+        assert flags["_args"] == ["extra"]
+
+    def test_hyphenated_flags_bind_underscore_fields(self):
+        request = CMDRequest(["migrate", "--dry-run"])
+        assert request.bind()["dry_run"] == "true"
 
     def test_repeat_and_csv_params(self):
         request = CMDRequest(["x", "-t=a", "-t=b,c"])

@@ -1,0 +1,284 @@
+"""Breadth datasource families: document, columnar, graph, time-series.
+
+Mirrors the reference's per-module driver tests (datasource/mongo,
+datasource/cassandra, ... *_test.go): each store's native surface is
+exercised against the embedded engine, plus container registration and
+health aggregation.
+"""
+
+import pytest
+
+from gofr_tpu.container.container import Container
+from gofr_tpu.container.mock import new_mock_container
+from gofr_tpu.datasource.columnar import (BatchNotInitialised, Cassandra,
+                                          Clickhouse, Oracle, ScyllaDB)
+from gofr_tpu.datasource.document import (Couchbase, DocumentNotFound,
+                                          Elasticsearch, Mongo, Solr)
+from gofr_tpu.datasource.graph import (ArangoDB, Dgraph, GraphError,
+                                       NodeNotFound, SurrealDB)
+from gofr_tpu.datasource.timeseries import (InfluxDB, OpenTSDB,
+                                            TimeseriesError)
+
+
+# ---------------------------------------------------------------- document
+class TestMongo:
+    def test_crud_roundtrip(self):
+        m = Mongo()
+        m.connect()
+        m.insert_one("users", {"name": "ada", "age": 36})
+        m.insert_one("users", {"name": "grace", "age": 45})
+        assert m.count_documents("users") == 2
+        hits = m.find("users", {"age": {"$gt": 40}})
+        assert [h["name"] for h in hits] == ["grace"]
+        assert m.find_one("users", {"name": "ada"})["age"] == 36
+        assert m.update_many("users", {"name": "ada"},
+                             {"$set": {"age": 37}}) == 1
+        assert m.find_one("users", {"name": "ada"})["age"] == 37
+        assert m.delete_many("users", {"age": {"$lt": 40}}) == 1
+        assert m.count_documents("users") == 1
+
+    def test_filter_operators(self):
+        m = Mongo()
+        m.insert_many("n", [{"v": i} for i in range(5)])
+        assert len(m.find("n", {"v": {"$gte": 2, "$lte": 3}})) == 2
+        assert len(m.find("n", {"v": {"$ne": 0}})) == 4
+        assert len(m.find("n", {"v": {"$in": [1, 4, 9]}})) == 2
+
+    def test_health_and_metrics(self):
+        c = new_mock_container()
+        m = c.add_mongo(Mongo())
+        m.insert_one("t", {"x": 1})
+        assert c.health()["checks"]["mongo"]["status"] == "UP"
+        assert c.metrics.get_histogram_count("app_mongo_stats", type="insert") == 1
+
+
+class TestElasticsearch:
+    def test_index_search_ranking(self):
+        es = Elasticsearch()
+        es.index("docs", 1, {"title": "tpu systolic matmul"})
+        es.index("docs", 2, {"title": "hbm bandwidth tpu"})
+        es.index("docs", 3, {"title": "unrelated prose"})
+        out = es.search("docs", {"match": {"title": "tpu matmul"}})
+        assert out["hits"]["total"]["value"] == 2
+        assert out["hits"]["hits"][0]["_id"] == 1  # 2-token overlap first
+
+    def test_term_get_delete_bulk(self):
+        es = Elasticsearch()
+        assert es.bulk("i", [(n, {"k": n % 2}) for n in range(4)]) == 4
+        assert es.search("i", {"term": {"k": 0}})["hits"]["total"]["value"] == 2
+        assert es.get("i", 3)["k"] == 1
+        es.delete("i", 3)
+        with pytest.raises(DocumentNotFound):
+            es.get("i", 3)
+
+
+class TestSolrCouchbase:
+    def test_solr_add_search(self):
+        s = Solr()
+        s.add("books", [{"id": "b1", "title": "jax on tpu"},
+                        {"id": "b2", "title": "go services"}])
+        assert s.search("books", "title:jax on tpu")["response"]["numFound"] == 1
+        assert s.search("books", "*:*")["response"]["numFound"] == 2
+        s.delete("books", "b1")
+        assert s.search("books", "*:*")["response"]["numFound"] == 1
+
+    def test_couchbase_bucket_ops(self):
+        cb = Couchbase()
+        cb.upsert("main", "u:1", {"name": "ada"})
+        cb.insert("main", "u:2", {"name": "grace"})
+        assert cb.get("main", "u:1")["name"] == "ada"
+        assert len(cb.query("main")) == 2
+        cb.remove("main", "u:1")
+        with pytest.raises(DocumentNotFound):
+            cb.remove("main", "u:1")
+
+
+# ---------------------------------------------------------------- columnar
+@pytest.mark.parametrize("cls", [Cassandra, ScyllaDB, Clickhouse, Oracle])
+def test_cql_family_statements(cls):
+    store = cls()
+    store.connect()
+    store.exec("CREATE TABLE t (id INTEGER PRIMARY KEY, name TEXT)")
+    store.exec("INSERT INTO t (id, name) VALUES (?, ?)", 1, "ada")
+    store.exec("INSERT INTO t (id, name) VALUES (?, ?)", 2, "grace")
+    rows = store.query("SELECT * FROM t ORDER BY id")
+    assert [r["name"] for r in rows] == ["ada", "grace"]
+    assert store.health_check()["status"] == "UP"
+    store.close()
+    assert store.health_check()["status"] == "DOWN"
+
+
+def test_cassandra_batch_atomicity():
+    c = Cassandra()
+    c.connect()
+    c.exec("CREATE TABLE t (id INTEGER PRIMARY KEY)")
+    c.new_batch("b1")
+    c.batch_query("b1", "INSERT INTO t (id) VALUES (?)", 1)
+    c.batch_query("b1", "INSERT INTO t (id) VALUES (?)", 2)
+    c.execute_batch("b1")
+    assert len(c.query("SELECT * FROM t")) == 2
+    # failing batch rolls back entirely
+    c.new_batch("b2")
+    c.batch_query("b2", "INSERT INTO t (id) VALUES (?)", 3)
+    c.batch_query("b2", "INSERT INTO t (id) VALUES (?)", 1)  # dup PK
+    with pytest.raises(Exception):
+        c.execute_batch("b2")
+    assert len(c.query("SELECT * FROM t")) == 2
+    with pytest.raises(BatchNotInitialised):
+        c.batch_query("nope", "SELECT 1")
+
+
+def test_cql_strips_cql_only_clauses():
+    c = ScyllaDB()
+    c.connect()
+    c.exec("CREATE TABLE t (id INTEGER)")
+    c.exec("INSERT INTO t (id) VALUES (?) USING TTL 60", 1)
+    assert c.query("SELECT * FROM t ALLOW FILTERING") == [{"id": 1}]
+
+
+def test_oracle_tx_commit_rollback():
+    o = Oracle()
+    o.connect()
+    o.exec("CREATE TABLE m (v INTEGER)")
+    tx = o.begin()
+    tx.exec("INSERT INTO m (v) VALUES (?)", 1)
+    tx.rollback()
+    assert o.select("SELECT * FROM m") == []
+    tx = o.begin()
+    tx.exec("INSERT INTO m (v) VALUES (?)", 2)
+    tx.commit()
+    assert o.select("SELECT * FROM m") == [{"v": 2}]
+
+
+# ------------------------------------------------------------------- graph
+class TestDgraph:
+    def test_mutate_query_expand(self):
+        d = Dgraph()
+        d.connect()
+        uids = d.mutate({"uid": "_:ada", "name": "ada",
+                         "follows": [{"name": "grace"}, {"name": "alan"}]})
+        assert "ada" in uids
+        hits = d.query({"name": "ada"}, expand="follows")
+        assert len(hits) == 1
+        assert {f["name"] for f in hits[0]["follows"]} == {"grace", "alan"}
+        d.alter("name: string @index(term) .")
+        assert d.schema
+
+    def test_edge_to_missing_node(self):
+        d = Dgraph()
+        with pytest.raises(NodeNotFound):
+            d.engine.add_edge("knows", "0xdead", "0xbeef")
+
+
+class TestArango:
+    def test_documents_and_traversal(self):
+        a = ArangoDB()
+        a.connect()
+        i1 = a.create_document("people", {"name": "ada"})
+        i2 = a.create_document("people", {"name": "grace"})
+        i3 = a.create_document("people", {"name": "alan"})
+        a.create_edge_document("knows", i1, i2)
+        a.create_edge_document("knows", i2, i3)
+        assert a.get_document("people", i1)["name"] == "ada"
+        a.update_document("people", i1, {"name": "ada lovelace"})
+        two_hops = a.traversal(i1, "knows", depth=2)
+        assert [d["name"] for d in two_hops] == ["grace", "alan"]
+        a.delete_document("people", i3)
+        assert len(a.query("people")) == 2
+
+
+class TestSurreal:
+    def test_record_id_crud(self):
+        s = SurrealDB()
+        s.connect()
+        created = s.create("user:ada", {"age": 36})
+        assert created["id"] == "user:ada"
+        s.create("user", {"age": 45})  # engine-assigned id
+        assert len(s.select("user")) == 2
+        assert s.select("user:ada")[0]["age"] == 36
+        assert s.update("user:ada", {"age": 37})["age"] == 37
+        with pytest.raises(GraphError):
+            s.update("user", {})
+        s.delete("user:ada")
+        assert len(s.query("user")) == 1
+
+
+# ------------------------------------------------------------- time-series
+class TestOpenTSDB:
+    def test_put_query_aggregate(self):
+        t = OpenTSDB()
+        t.connect()
+        t.put_data_points([
+            {"metric": "sys.cpu", "timestamp": 100, "value": 10,
+             "tags": {"host": "a"}},
+            {"metric": "sys.cpu", "timestamp": 200, "value": 30,
+             "tags": {"host": "a"}},
+            {"metric": "sys.cpu", "timestamp": 300, "value": 50,
+             "tags": {"host": "b"}},
+        ])
+        out = t.query("sys.cpu", "avg", start=100, end=250)
+        assert out["value"] == 20
+        assert t.query("sys.cpu", "max")["value"] == 50
+        only_a = t.query("sys.cpu", "sum", tags={"host": "a"})
+        assert only_a["value"] == 40
+        with pytest.raises(TimeseriesError):
+            t.engine.aggregate("sys.cpu", "median")
+
+    def test_annotations(self):
+        t = OpenTSDB()
+        t.put_annotation({"startTime": 150, "description": "deploy"})
+        assert t.query_annotations(100, 200)[0]["description"] == "deploy"
+        assert t.query_annotations(300, 400) == []
+
+
+class TestInfluxDB:
+    def test_buckets_and_points(self):
+        i = InfluxDB()
+        i.connect()
+        i.create_bucket("metrics")
+        i.write_point("metrics", "temp", 1.0, {"c": 21.0}, {"room": "lab"})
+        i.write_point("metrics", "temp", 2.0, {"c": 23.0}, {"room": "lab"})
+        pts = i.query("metrics", "temp", "c")
+        assert pts == [(1.0, 21.0), (2.0, 23.0)]
+        assert i.aggregate("metrics", "temp", "c", "avg") == 22.0
+        assert i.health_check()["details"]["buckets"] == 1
+        i.delete_bucket("metrics")
+        assert i.list_buckets() == []
+
+
+# ----------------------------------------------- container + context wiring
+def test_container_holds_every_breadth_slot():
+    c = Container()
+    stores = {
+        "mongo": Mongo(), "elasticsearch": Elasticsearch(), "solr": Solr(),
+        "couchbase": Couchbase(), "cassandra": Cassandra(),
+        "scylladb": ScyllaDB(), "clickhouse": Clickhouse(),
+        "oracle": Oracle(), "dgraph": Dgraph(), "arangodb": ArangoDB(),
+        "surrealdb": SurrealDB(), "opentsdb": OpenTSDB(),
+        "influxdb": InfluxDB(),
+    }
+    for name, store in stores.items():
+        added = getattr(c, f"add_{name}")(store)
+        assert added is store
+        assert store.logger is c.logger  # provider wiring ran
+    checks = c.health()["checks"]
+    for name in stores:
+        assert checks[name]["status"] == "UP", name
+
+
+def test_context_resolves_breadth_slots():
+    from gofr_tpu.context import Context
+    c = new_mock_container()
+    c.add_dgraph(Dgraph())
+    ctx = Context(request=None, container=c)
+    assert ctx.dgraph is c.dgraph
+    with pytest.raises(AttributeError):
+        ctx.no_such_store
+
+
+def test_mock_container_can_mock_breadth_slot():
+    c = new_mock_container()
+    rec = c.mock("cassandra")
+    rec.expect("query", [{"id": 7}])
+    assert c.cassandra.query("SELECT ...") == [{"id": 7}]
+    assert rec.calls_to("query")
