@@ -75,6 +75,9 @@ class App:
     def _register_default_routes(self) -> None:
         self.router.add("GET", "/.well-known/health", self._health_handler)
         self.router.add("GET", "/.well-known/alive", self._alive_handler)
+        # OpenAPI spec + Swagger UI (reference swagger.go:59-70)
+        from .openapi import register as register_openapi
+        register_openapi(self)
 
     @staticmethod
     def _alive_handler(ctx: Context) -> Any:
@@ -307,6 +310,7 @@ class App:
     async def start(self) -> None:
         """Boot all servers without blocking (for tests / embedding)."""
         self._stop_event = asyncio.Event()
+        await self.container.connect_async()
         if not await self._run_start_hooks():
             raise RuntimeError("on_start hook failed")
 
@@ -336,11 +340,31 @@ class App:
         if self._cron is not None:
             self._tasks.append(asyncio.ensure_future(self._cron.run()))
 
+        # remote log-level polling (reference container.go:107)
+        from .logging.remote import from_config as remote_level_from_config
+        updater = remote_level_from_config(self.config, self.logger,
+                                           self.container.metrics)
+        if updater is not None:
+            self._tasks.append(asyncio.ensure_future(updater.run()))
+
+        # usage telemetry, opt-out (reference telemetry.go:13-38)
+        from . import telemetry
+        if telemetry.enabled(self.config):
+            self._tasks.append(asyncio.ensure_future(
+                telemetry.ping(self.container, "start")))
+
         self.logger.info(
             f"{self.container.app_name} up: http={self.http_server.bound_port} "
             f"metrics={self.metrics_server.bound_port}")
 
     async def stop(self) -> None:
+        from . import telemetry
+        ping_task: asyncio.Task | None = None
+        if telemetry.enabled(self.config):
+            # fire-and-forget: the ping gets the duration of the rest of
+            # shutdown to complete, never delaying it (telemetry.py)
+            ping_task = asyncio.ensure_future(
+                telemetry.ping(self.container, "shutdown"))
         for hook in self._on_shutdown:
             try:
                 result = hook()
@@ -358,6 +382,8 @@ class App:
             await server.shutdown()
         self._servers.clear()
         await self.container.close()
+        if ping_task is not None and not ping_task.done():
+            ping_task.cancel()
         if self._stop_event is not None:
             self._stop_event.set()
 

@@ -131,7 +131,12 @@ class CMDApp:
         try:
             result = sub.handler(ctx)
             if hasattr(result, "__await__"):
-                result = asyncio.run(result)
+                async def _drain_then_run(coro):
+                    # async-connect stores (NATS/MQTT pubsub) defer until
+                    # a loop exists; CLI apps get one per async handler
+                    await self.container.connect_async()
+                    return await coro
+                result = asyncio.run(_drain_then_run(result))
             return responder.respond(result, None)
         except Exception as exc:
             self.logger.debug(f"subcommand {sub.pattern!r} failed: {exc!r}")

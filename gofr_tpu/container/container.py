@@ -62,6 +62,9 @@ class Container:
             setattr(self, slot, None)
         self.tpu: Any = None                 # TPU device registry / runtime
         self.models: dict[str, Any] = {}     # name -> serving engine
+        # stores with async connect (network brokers) wait here until an
+        # event loop exists; App.start awaits connect_async()
+        self._deferred_connects: list[Any] = []
         self._start_time = time.time()
 
     # ------------------------------------------------------------ factory
@@ -91,6 +94,38 @@ class Container:
         from ..datasource.sql import new_sql
         c.sql = new_sql(config, logger, c.metrics, c.tracer)
         c.redis = new_redis(config, logger, c.metrics, c.tracer)
+
+        # pub/sub backend switch (reference container.go:132-172 selects
+        # KAFKA/GOOGLE/MQTT from PUBSUB_BACKEND; ours: NATS/MQTT/MEMORY)
+        backend = config.get_or_default("PUBSUB_BACKEND", "").upper()
+        if backend == "NATS":
+            from ..pubsub.nats import NATSClient
+            addr = config.get_or_default("PUBSUB_BROKER", "127.0.0.1:4222")
+            addr = addr.split("://", 1)[-1]  # tolerate nats:// scheme
+            host, _, port_s = addr.rpartition(":")
+            try:
+                port = int(port_s)
+            except ValueError:
+                host, port = addr, 4222  # bare hostname, default port
+            c.add_pubsub(NATSClient(host or "127.0.0.1", port,
+                                    name=c.app_name))
+        elif backend == "MQTT":
+            from ..pubsub.mqtt import MQTTClient
+            try:
+                qos = int(config.get_or_default("MQTT_QOS", "1"))
+            except ValueError:
+                qos = 1
+            # the client implements QoS 0/1 (QoS 2 would wait for a
+            # PUBACK that spec brokers answer with PUBREC)
+            qos = min(max(qos, 0), 1)
+            c.add_pubsub(MQTTClient(
+                host=config.get_or_default("MQTT_HOST", "127.0.0.1"),
+                port=int(config.get_or_default("MQTT_PORT", "1883")),
+                client_id=config.get_or_default("MQTT_CLIENT_ID", c.app_name),
+                qos=qos))
+        elif backend in ("MEMORY", "INMEMORY"):
+            from ..pubsub.inmemory import InMemoryBroker
+            c.add_pubsub(InMemoryBroker(logger=logger, metrics=c.metrics))
         return c
 
     # ------------------------------------------------- framework metrics
@@ -193,8 +228,24 @@ class Container:
                 fn(dep)
         connect = getattr(store, "connect", None)
         if connect is not None:
-            connect()
+            import inspect
+            if inspect.iscoroutinefunction(connect):
+                self._deferred_connects.append(store)
+            else:
+                connect()
         return store
+
+    async def connect_async(self) -> None:
+        """Await every deferred (async) connect; failures log and leave
+        the store down (health reports it), matching the reference's
+        retry-in-background stance rather than failing boot."""
+        while self._deferred_connects:
+            store = self._deferred_connects.pop(0)
+            try:
+                await store.connect()
+            except Exception as exc:
+                self.logger.error(
+                    f"connect {type(store).__name__} failed: {exc!r}")
 
     def add_sql(self, store: Any) -> Any:
         self.sql = self._provide(store)

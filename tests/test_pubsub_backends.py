@@ -1,0 +1,275 @@
+"""NATS and MQTT wire-protocol backends against their in-process mini
+servers — the broker analog of the reference's miniredis-style tests
+(SURVEY §4): the real client bytes go over a real TCP socket.
+"""
+
+import asyncio
+
+import functools
+
+import pytest
+
+from gofr_tpu.config.env import DictConfig
+from gofr_tpu.container.container import Container
+from gofr_tpu.pubsub.mqtt import (MiniMQTTBroker, MQTTClient, encode_varint,
+                                  topic_matches)
+from gofr_tpu.pubsub.nats import MiniNATSServer, NATSClient, subject_matches
+
+
+def async_test(fn):
+    """No pytest-asyncio in the image; run coroutine tests via asyncio.run
+    (the repo-wide pattern, cf. tests/test_pubsub.py)."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return asyncio.run(fn(*args, **kwargs))
+    return wrapper
+
+
+# ------------------------------------------------------------------ matching
+@pytest.mark.parametrize("pattern,subject,ok", [
+    ("orders.created", "orders.created", True),
+    ("orders.*", "orders.created", True),
+    ("orders.*", "orders.created.eu", False),
+    ("orders.>", "orders.created.eu", True),
+    (">", "anything.at.all", True),
+    ("orders.created", "orders", False),
+])
+def test_nats_subject_matching(pattern, subject, ok):
+    assert subject_matches(pattern, subject) is ok
+
+
+@pytest.mark.parametrize("pattern,topic,ok", [
+    ("a/b", "a/b", True),
+    ("a/+", "a/b", True),
+    ("a/+", "a/b/c", False),
+    ("a/#", "a/b/c", True),
+    ("#", "x/y", True),
+    ("a/b", "a", False),
+])
+def test_mqtt_topic_matching(pattern, topic, ok):
+    assert topic_matches(pattern, topic) is ok
+
+
+def test_mqtt_varint():
+    assert encode_varint(0) == b"\x00"
+    assert encode_varint(127) == b"\x7f"
+    assert encode_varint(128) == b"\x80\x01"
+    assert encode_varint(16383) == b"\xff\x7f"
+
+
+# ---------------------------------------------------------------------- NATS
+class TestNATS:
+    @async_test
+    async def test_pub_sub_roundtrip(self):
+        server = MiniNATSServer()
+        await server.start()
+        client = NATSClient(port=server.port)
+        await client.connect()
+        try:
+            task = asyncio.ensure_future(client.subscribe("greetings", ""))
+            await asyncio.sleep(0.05)  # let SUB reach the server
+            await client.publish("greetings", {"hello": "tpu"})
+            msg = await asyncio.wait_for(task, timeout=2)
+            assert msg.topic == "greetings"
+            assert msg.bind() == {"hello": "tpu"}
+            msg.commit()  # no-op, must not raise
+            assert client.health_check()["status"] == "UP"
+        finally:
+            await client.close()
+            await server.close()
+
+    @async_test
+    async def test_queue_group_balances_and_plain_subs_fan_out(self):
+        server = MiniNATSServer()
+        await server.start()
+        worker_a = NATSClient(port=server.port, name="a")
+        worker_b = NATSClient(port=server.port, name="b")
+        audit = NATSClient(port=server.port, name="audit")
+        for c in (worker_a, worker_b, audit):
+            await c.connect()
+        try:
+            ta = asyncio.ensure_future(worker_a.subscribe("jobs", "workers"))
+            tb = asyncio.ensure_future(worker_b.subscribe("jobs", "workers"))
+            taudit = asyncio.ensure_future(audit.subscribe("jobs", ""))
+            await asyncio.sleep(0.05)
+            await worker_a.publish("jobs", b"j1")
+            await worker_a.publish("jobs", b"j2")
+            # audit (plain sub) sees both; the queue group sees each once
+            m1 = await asyncio.wait_for(taudit, 2)
+            m2 = await asyncio.wait_for(audit.subscribe("jobs", ""), 2)
+            assert {m1.value, m2.value} == {b"j1", b"j2"}
+            group_msgs = await asyncio.wait_for(
+                asyncio.gather(ta, tb), timeout=2)
+            assert {m.value for m in group_msgs} == {b"j1", b"j2"}
+        finally:
+            for c in (worker_a, worker_b, audit):
+                await c.close()
+            await server.close()
+
+    @async_test
+    async def test_wildcard_subscription(self):
+        server = MiniNATSServer()
+        await server.start()
+        client = NATSClient(port=server.port)
+        await client.connect()
+        try:
+            task = asyncio.ensure_future(client.subscribe("orders.>", ""))
+            await asyncio.sleep(0.05)
+            await client.publish("orders.created.eu", b"x")
+            msg = await asyncio.wait_for(task, 2)
+            assert msg.topic == "orders.created.eu"
+        finally:
+            await client.close()
+            await server.close()
+
+
+# ---------------------------------------------------------------------- MQTT
+class TestMQTT:
+    @async_test
+    async def test_pub_sub_qos1_roundtrip(self):
+        broker = MiniMQTTBroker()
+        await broker.start()
+        client = MQTTClient(port=broker.port, qos=1)
+        await client.connect()
+        try:
+            await client._ensure_sub("sensors/temp")
+            await client.publish("sensors/temp", {"c": 21.5})
+            msg = await asyncio.wait_for(
+                client.subscribe("sensors/temp"), timeout=2)
+            assert msg.bind() == {"c": 21.5}
+            msg.commit()  # sends PUBACK for the inbound QoS1 message
+            assert client.health_check()["status"] == "UP"
+        finally:
+            await client.close()
+            await broker.close()
+
+    @async_test
+    async def test_wildcard_and_two_clients(self):
+        broker = MiniMQTTBroker()
+        await broker.start()
+        alice = MQTTClient(port=broker.port, client_id="alice")
+        bob = MQTTClient(port=broker.port, client_id="bob")
+        await alice.connect()
+        await bob.connect()
+        try:
+            await bob._ensure_sub("chat/+/msg")
+            await alice.publish("chat/room1/msg", b"hi")
+            msg = await asyncio.wait_for(bob.subscribe("chat/+/msg"), 2)
+            assert msg.topic == "chat/room1/msg"
+            assert msg.value == b"hi"
+        finally:
+            await alice.close()
+            await bob.close()
+            await broker.close()
+
+    @async_test
+    async def test_retained_message_replays_to_new_subscriber(self):
+        broker = MiniMQTTBroker()
+        await broker.start()
+        publisher = MQTTClient(port=broker.port, client_id="p", retain=True)
+        await publisher.connect()
+        await publisher.publish("config/mode", b"serving")
+        late = MQTTClient(port=broker.port, client_id="late")
+        await late.connect()
+        try:
+            msg = await asyncio.wait_for(late.subscribe("config/#"), 2)
+            assert msg.topic == "config/mode"
+            assert msg.value == b"serving"
+        finally:
+            await publisher.close()
+            await late.close()
+            await broker.close()
+
+    @async_test
+    async def test_qos0_no_ack(self):
+        broker = MiniMQTTBroker()
+        await broker.start()
+        client = MQTTClient(port=broker.port, qos=0)
+        await client.connect()
+        try:
+            await client._ensure_sub("t")
+            await client.publish("t", b"fire-and-forget")
+            msg = await asyncio.wait_for(client.subscribe("t"), 2)
+            assert msg.value == b"fire-and-forget"
+        finally:
+            await client.close()
+            await broker.close()
+
+
+# -------------------------------------------------------- container wiring
+class TestBackendSelection:
+    def test_env_selects_nats(self):
+        c = Container.create(DictConfig({"PUBSUB_BACKEND": "NATS",
+                                         "PUBSUB_BROKER": "10.0.0.9:5222"}))
+        assert type(c.pubsub).__name__ == "NATSClient"
+        assert (c.pubsub.host, c.pubsub.port) == ("10.0.0.9", 5222)
+        assert c.pubsub in c._deferred_connects  # async connect deferred
+
+    def test_broker_addr_tolerates_scheme_and_bare_host(self):
+        c = Container.create(DictConfig({"PUBSUB_BACKEND": "NATS",
+                                         "PUBSUB_BROKER": "nats://h1:9000"}))
+        assert (c.pubsub.host, c.pubsub.port) == ("h1", 9000)
+        c2 = Container.create(DictConfig({"PUBSUB_BACKEND": "NATS",
+                                          "PUBSUB_BROKER": "justahost"}))
+        assert (c2.pubsub.host, c2.pubsub.port) == ("justahost", 4222)
+
+    def test_mqtt_qos_clamped_to_implemented_range(self):
+        c = Container.create(DictConfig({"PUBSUB_BACKEND": "MQTT",
+                                         "MQTT_QOS": "2"}))
+        assert c.pubsub.qos == 1
+
+    def test_env_selects_mqtt(self):
+        c = Container.create(DictConfig({"PUBSUB_BACKEND": "MQTT",
+                                         "MQTT_PORT": "2883",
+                                         "MQTT_QOS": "0"}))
+        assert type(c.pubsub).__name__ == "MQTTClient"
+        assert c.pubsub.port == 2883
+        assert c.pubsub.qos == 0
+
+    def test_env_selects_memory(self):
+        c = Container.create(DictConfig({"PUBSUB_BACKEND": "MEMORY"}))
+        assert type(c.pubsub).__name__ == "InMemoryBroker"
+
+    @async_test
+    async def test_connect_async_failure_leaves_store_down(self):
+        c = Container.create(DictConfig({"PUBSUB_BACKEND": "NATS",
+                                         "PUBSUB_BROKER": "127.0.0.1:1"}))
+        await c.connect_async()  # refused connection: logged, not raised
+        assert c.pubsub.health_check()["status"] == "DOWN"
+        assert c._deferred_connects == []
+
+
+# --------------------------------------------- end-to-end subscriber runtime
+@async_test
+async def test_subscriber_runtime_over_nats():
+    """App-style flow: SubscriptionManager pulls from a real NATS server
+    and drives a handler with commit-on-success."""
+    from gofr_tpu.container.mock import new_mock_container
+    from gofr_tpu.pubsub.subscriber import SubscriptionManager
+
+    server = MiniNATSServer()
+    await server.start()
+    container = new_mock_container()
+    client = NATSClient(port=server.port)
+    container.add_pubsub(client)
+    await container.connect_async()
+
+    received = asyncio.Event()
+    seen = []
+
+    async def handler(ctx):
+        seen.append(ctx.bind())
+        received.set()
+        return None
+
+    manager = SubscriptionManager(container)
+    task = asyncio.ensure_future(manager.start_subscriber("events", handler))
+    try:
+        await asyncio.sleep(0.1)  # subscriber loop issues SUB
+        await client.publish("events", {"kind": "ping"})
+        await asyncio.wait_for(received.wait(), timeout=3)
+        assert seen == [{"kind": "ping"}]
+    finally:
+        task.cancel()
+        await client.close()
+        await server.close()
