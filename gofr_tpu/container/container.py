@@ -118,9 +118,15 @@ class Container:
             # the client implements QoS 0/1 (QoS 2 would wait for a
             # PUBACK that spec brokers answer with PUBREC)
             qos = min(max(qos, 0), 1)
+            try:
+                mqtt_port = int(config.get_or_default("MQTT_PORT",
+                                                      "1883").strip())
+            except ValueError:
+                logger.error("invalid MQTT_PORT; using 1883")
+                mqtt_port = 1883
             c.add_pubsub(MQTTClient(
                 host=config.get_or_default("MQTT_HOST", "127.0.0.1"),
-                port=int(config.get_or_default("MQTT_PORT", "1883")),
+                port=mqtt_port,
                 client_id=config.get_or_default("MQTT_CLIENT_ID", c.app_name),
                 qos=qos))
         elif backend in ("MEMORY", "INMEMORY"):

@@ -93,8 +93,14 @@ def from_config(config: Any, logger: Any,
     url = config.get_or_default("REMOTE_LOG_URL", "")
     if not url:
         return None
-    interval = float(config.get_or_default("REMOTE_LOG_FETCH_INTERVAL",
-                                           str(DEFAULT_INTERVAL_S)))
+    try:
+        interval = float(config.get_or_default("REMOTE_LOG_FETCH_INTERVAL",
+                                               str(DEFAULT_INTERVAL_S)))
+    except ValueError:
+        logger.error("invalid REMOTE_LOG_FETCH_INTERVAL; using default")
+        interval = DEFAULT_INTERVAL_S
+    # a zero/negative interval would hot-loop against the endpoint
+    interval = max(interval, 1.0)
     from ..service.client import HTTPService
     from urllib.parse import urlsplit
     parts = urlsplit(url)

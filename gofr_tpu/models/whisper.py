@@ -1,0 +1,384 @@
+"""Whisper-family encoder-decoder for ASR (baseline config 4:
+"Whisper-large ASR via Pub/Sub batch").
+
+Pure-functional, same TPU-first structure as the Llama module:
+stacked per-layer weights scanned with ``lax.scan`` (flat compile time
+at any depth), bf16 matmuls with f32 norms/softmax, static shapes
+end-to-end. The audio frontend (ops/audio.py) runs in the same program
+so mel extraction happens on-device.
+
+Architecture (Whisper v2/v3 shape): conv1d×2 downsampling + sinusoidal
+positions -> pre-LN transformer encoder; decoder with causal
+self-attention (KV cache), cross-attention over the encoder output
+(K/V precomputed once per utterance), learned positions, tied output
+embedding. Greedy transcription is a single ``lax.scan`` over decode
+steps with per-sequence end-of-text masking — one compiled graph per
+(batch, max_tokens) bucket, donated caches.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import decode_attention, xla_attention
+from ..ops.audio import log_mel_spectrogram
+from ..ops.norms import layer_norm
+
+
+@dataclass(frozen=True)
+class WhisperConfig:
+    vocab_size: int = 51866
+    n_mels: int = 80
+    audio_frames: int = 3000     # 30 s at 10 ms hop
+    audio_ctx: int = 1500        # frames after conv stride-2
+    text_ctx: int = 448
+    dim: int = 1280
+    n_heads: int = 20
+    n_audio_layers: int = 32
+    n_text_layers: int = 32
+    sot_token: int = 50258       # <|startoftranscript|>
+    eot_token: int = 50257       # <|endoftext|>
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    # ---- presets -----------------------------------------------------
+    @classmethod
+    def tiny_test(cls) -> "WhisperConfig":
+        """Milliseconds-everywhere test shape."""
+        return cls(vocab_size=128, n_mels=8, audio_frames=64, audio_ctx=32,
+                   text_ctx=32, dim=32, n_heads=4, n_audio_layers=2,
+                   n_text_layers=2, sot_token=1, eot_token=2,
+                   dtype=jnp.float32)
+
+    @classmethod
+    def whisper_tiny(cls) -> "WhisperConfig":
+        return cls(dim=384, n_heads=6, n_audio_layers=4, n_text_layers=4,
+                   vocab_size=51865)
+
+    @classmethod
+    def whisper_base(cls) -> "WhisperConfig":
+        return cls(dim=512, n_heads=8, n_audio_layers=6, n_text_layers=6,
+                   vocab_size=51865)
+
+    @classmethod
+    def whisper_small(cls) -> "WhisperConfig":
+        return cls(dim=768, n_heads=12, n_audio_layers=12, n_text_layers=12,
+                   vocab_size=51865)
+
+    @classmethod
+    def whisper_large_v3(cls) -> "WhisperConfig":
+        return cls(n_mels=128)   # large defaults; v3 uses 128 mels
+
+    def scaled(self, **kw) -> "WhisperConfig":
+        return replace(self, **kw)
+
+
+# ---------------------------------------------------------------- params
+
+def _sinusoids(length: int, channels: int) -> np.ndarray:
+    """Whisper's encoder positional table (log-spaced sinusoids)."""
+    log_timescale = np.log(10000.0) / (channels // 2 - 1)
+    inv = np.exp(-log_timescale * np.arange(channels // 2))
+    scaled = np.arange(length)[:, None] * inv[None, :]
+    return np.concatenate([np.sin(scaled), np.cos(scaled)],
+                          axis=1).astype(np.float32)
+
+
+def _block_init(key, L: int, dim: int, n_heads: int, dtype,
+                cross: bool) -> dict:
+    """Stacked transformer-block weights; pre-LN, GELU MLP (4x)."""
+    hd = dim
+    keys = jax.random.split(key, 12)
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dtype)
+
+    def zeros(shape):
+        return jnp.zeros(shape, dtype)
+
+    def ln(shape):
+        return jnp.ones(shape, dtype)
+
+    block = {
+        "ln1_w": ln((L, dim)), "ln1_b": zeros((L, dim)),
+        "wq": dense(keys[0], (L, dim, hd), dim), "bq": zeros((L, hd)),
+        "wk": dense(keys[1], (L, dim, hd), dim),     # no k bias (Whisper)
+        "wv": dense(keys[2], (L, dim, hd), dim), "bv": zeros((L, hd)),
+        "wo": dense(keys[3], (L, hd, dim), hd), "bo": zeros((L, dim)),
+        "ln_mlp_w": ln((L, dim)), "ln_mlp_b": zeros((L, dim)),
+        "fc1": dense(keys[4], (L, dim, 4 * dim), dim),
+        "fc1_b": zeros((L, 4 * dim)),
+        "fc2": dense(keys[5], (L, 4 * dim, dim), 4 * dim),
+        "fc2_b": zeros((L, dim)),
+    }
+    if cross:
+        block.update({
+            "lnx_w": ln((L, dim)), "lnx_b": zeros((L, dim)),
+            "xwq": dense(keys[6], (L, dim, hd), dim), "xbq": zeros((L, hd)),
+            "xwk": dense(keys[7], (L, dim, hd), dim),
+            "xwv": dense(keys[8], (L, dim, hd), dim), "xbv": zeros((L, hd)),
+            "xwo": dense(keys[9], (L, hd, dim), hd), "xbo": zeros((L, dim)),
+        })
+    return block
+
+
+def whisper_init(key: jax.Array, config: WhisperConfig) -> dict:
+    c = config
+    ks = jax.random.split(key, 6)
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(c.dtype)
+
+    return {
+        "conv1_w": dense(ks[0], (3, c.n_mels, c.dim), 3 * c.n_mels),
+        "conv1_b": jnp.zeros((c.dim,), c.dtype),
+        "conv2_w": dense(ks[1], (3, c.dim, c.dim), 3 * c.dim),
+        "conv2_b": jnp.zeros((c.dim,), c.dtype),
+        "enc_pos": jnp.asarray(_sinusoids(c.audio_ctx, c.dim), c.dtype),
+        "enc_layers": _block_init(ks[2], c.n_audio_layers, c.dim,
+                                  c.n_heads, c.dtype, cross=False),
+        "enc_ln_w": jnp.ones((c.dim,), c.dtype),
+        "enc_ln_b": jnp.zeros((c.dim,), c.dtype),
+        "embed": (jax.random.normal(ks[3], (c.vocab_size, c.dim),
+                                    jnp.float32) * 0.02).astype(c.dtype),
+        "dec_pos": (jax.random.normal(ks[4], (c.text_ctx, c.dim),
+                                      jnp.float32) * 0.01).astype(c.dtype),
+        "dec_layers": _block_init(ks[5], c.n_text_layers, c.dim,
+                                  c.n_heads, c.dtype, cross=True),
+        "dec_ln_w": jnp.ones((c.dim,), c.dtype),
+        "dec_ln_b": jnp.zeros((c.dim,), c.dtype),
+    }
+
+
+# ---------------------------------------------------------------- encoder
+
+def _heads(x, n_heads):
+    b, s, d = x.shape
+    return x.reshape(b, s, n_heads, d // n_heads)
+
+
+def _merge(x):
+    b, s, h, hd = x.shape
+    return x.reshape(b, s, h * hd)
+
+
+def _self_attn(x, lp, c: WhisperConfig, causal=False):
+    q = _heads(x @ lp["wq"] + lp["bq"], c.n_heads)
+    k = _heads(x @ lp["wk"], c.n_heads)
+    v = _heads(x @ lp["wv"] + lp["bv"], c.n_heads)
+    out = xla_attention(q, k, v, causal=causal)
+    return _merge(out) @ lp["wo"] + lp["bo"], k, v
+
+
+def whisper_encode(params: dict, mel: jnp.ndarray,
+                   config: WhisperConfig) -> jnp.ndarray:
+    """mel [B, frames, n_mels] -> encoder states [B, audio_ctx, dim]."""
+    c = config
+    x = mel.astype(c.dtype)
+    dn = ("NWC", "WIO", "NWC")
+    x = jax.nn.gelu(jax.lax.conv_general_dilated(
+        x, params["conv1_w"], (1,), "SAME", dimension_numbers=dn)
+        + params["conv1_b"])
+    x = jax.nn.gelu(jax.lax.conv_general_dilated(
+        x, params["conv2_w"], (2,), "SAME", dimension_numbers=dn)
+        + params["conv2_b"])
+    x = x + params["enc_pos"][None, :x.shape[1], :]
+
+    def body(h, lp):
+        a = layer_norm(h, lp["ln1_w"], lp["ln1_b"])
+        attn_out, _, _ = _self_attn(a, lp, c, causal=False)
+        h = h + attn_out
+        m = layer_norm(h, lp["ln_mlp_w"], lp["ln_mlp_b"])
+        h = h + (jax.nn.gelu(m @ lp["fc1"] + lp["fc1_b"])
+                 @ lp["fc2"] + lp["fc2_b"])
+        return h, None
+
+    x, _ = jax.lax.scan(body, x, params["enc_layers"])
+    return layer_norm(x, params["enc_ln_w"], params["enc_ln_b"])
+
+
+# ---------------------------------------------------------------- decoder
+
+def precompute_cross_kv(params: dict, enc: jnp.ndarray,
+                        config: WhisperConfig) -> tuple:
+    """Per-layer cross-attention K/V from the encoder output — computed
+    once per utterance, reused by every decode step.
+    Returns (k, v) each [L, B, audio_ctx, H, hd]."""
+    c = config
+    lp = params["dec_layers"]
+
+    def per_layer(wk, wv, bv):
+        k = _heads(enc @ wk, c.n_heads)
+        v = _heads(enc @ wv + bv, c.n_heads)
+        return k, v
+
+    return jax.vmap(per_layer)(lp["xwk"], lp["xwv"], lp["xbv"])
+
+
+def _decoder_prefill(params: dict, tokens: jnp.ndarray, positions,
+                     cross_k, cross_v, config: WhisperConfig):
+    """Full causal prefill over the start-token prompt.
+
+    tokens [B, S]; positions [S] absolute; cross_k/v [L,B,Sa,H,hd].
+    Returns (hidden [B,S,dim], per-layer self K/V [L,B,S,H,hd]).
+    """
+    c = config
+    x = params["embed"][tokens].astype(c.dtype) \
+        + params["dec_pos"][positions].astype(c.dtype)
+
+    def scan_body(h, xs):
+        lp, xk, xv = xs
+        a = layer_norm(h, lp["ln1_w"], lp["ln1_b"])
+        q = _heads(a @ lp["wq"] + lp["bq"], c.n_heads)
+        k = _heads(a @ lp["wk"], c.n_heads)
+        v = _heads(a @ lp["wv"] + lp["bv"], c.n_heads)
+        attn = xla_attention(q, k, v, causal=True)
+        h = h + (_merge(attn) @ lp["wo"] + lp["bo"])
+
+        xa = layer_norm(h, lp["lnx_w"], lp["lnx_b"])
+        xq = _heads(xa @ lp["xwq"] + lp["xbq"], c.n_heads)
+        xattn = xla_attention(xq, xk, xv, causal=False)
+        h = h + (_merge(xattn) @ lp["xwo"] + lp["xbo"])
+
+        m = layer_norm(h, lp["ln_mlp_w"], lp["ln_mlp_b"])
+        h = h + (jax.nn.gelu(m @ lp["fc1"] + lp["fc1_b"])
+                 @ lp["fc2"] + lp["fc2_b"])
+        return h, (k, v)
+
+    x, new_kv = jax.lax.scan(scan_body, x,
+                             (params["dec_layers"], cross_k, cross_v))
+    x = layer_norm(x, params["dec_ln_w"], params["dec_ln_b"])
+    return x, new_kv
+
+
+def _logits(params, hidden, config: WhisperConfig):
+    return (hidden.astype(jnp.float32)
+            @ params["embed"].T.astype(jnp.float32))
+
+
+# --------------------------------------------------------- decode caching
+
+def _decode_self_cache_update(cache_k, cache_v, new_k, new_v, lengths):
+    """Insert step K/V [L,B,1,H,hd] at per-sequence positions."""
+    rows = jnp.arange(cache_k.shape[2])[None, None, :]       # [1,1,Tmax]
+    write = (rows == lengths[None, :, None])[..., None, None]
+    cache_k = jnp.where(write, new_k.astype(cache_k.dtype), cache_k)
+    cache_v = jnp.where(write, new_v.astype(cache_v.dtype), cache_v)
+    return cache_k, cache_v
+
+
+def transcribe_greedy(params: dict, mel: jnp.ndarray,
+                      config: WhisperConfig, *,
+                      max_tokens: int = 64) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched greedy ASR: mel [B, frames, n_mels] ->
+    (tokens [B, max_tokens] int32, lengths [B] int32).
+
+    One jittable graph: encode -> cross-K/V precompute -> SOT prefill ->
+    ``lax.scan`` over decode steps with EOT freezing. Pad rows beyond a
+    sequence's EOT hold the EOT token.
+    """
+    c = config
+    b = mel.shape[0]
+    enc = whisper_encode(params, mel, c)
+    cross_k, cross_v = precompute_cross_kv(params, enc, c)
+
+    sot = jnp.full((b, 1), c.sot_token, jnp.int32)
+    hidden, first_kv = _decoder_prefill(
+        params, sot, jnp.arange(1), cross_k, cross_v, c)
+    first_logits = _logits(params, hidden[:, -1], c)
+
+    L = c.n_text_layers
+    t_max = max_tokens + 1
+    cache_k = jnp.zeros((L, b, t_max, c.n_heads, c.head_dim), c.dtype)
+    cache_v = jnp.zeros_like(cache_k)
+    cache_k, cache_v = _decode_self_cache_update(
+        cache_k, cache_v, first_kv[0], first_kv[1],
+        jnp.zeros((b,), jnp.int32))
+
+    first_tok = jnp.argmax(first_logits, axis=-1).astype(jnp.int32)
+    done0 = first_tok == c.eot_token
+    return _transcribe_loop(params, c, b, first_tok, done0, cache_k,
+                            cache_v, cross_k, cross_v, max_tokens)
+
+
+def _decoder_step_kv(params, tok, pos, cross_k, cross_v, c,
+                     cache_k, cache_v, lengths):
+    """One decode step that BOTH attends against and updates the cache.
+    Returns (logits [B,V], cache_k, cache_v)."""
+    x = params["embed"][tok[:, None]].astype(c.dtype) \
+        + params["dec_pos"][pos][None, None, :].astype(c.dtype)
+
+    lp = params["dec_layers"]
+
+    def scan_body(h, xs):
+        layer, xk, xv, kc, vc = xs
+        a = layer_norm(h, layer["ln1_w"], layer["ln1_b"])
+        q = _heads(a @ layer["wq"] + layer["bq"], c.n_heads)
+        k = _heads(a @ layer["wk"], c.n_heads)
+        v = _heads(a @ layer["wv"] + layer["bv"], c.n_heads)
+        rows = jnp.arange(kc.shape[1])[None, :]
+        write = (rows == lengths[:, None])[:, :, None, None]
+        kc = jnp.where(write, k.astype(kc.dtype), kc)
+        vc = jnp.where(write, v.astype(vc.dtype), vc)
+        attn = decode_attention(q, kc, vc, lengths + 1)
+        h = h + (_merge(attn) @ layer["wo"] + layer["bo"])
+
+        xa = layer_norm(h, layer["lnx_w"], layer["lnx_b"])
+        xq = _heads(xa @ layer["xwq"] + layer["xbq"], c.n_heads)
+        xattn = xla_attention(xq, xk, xv, causal=False)
+        h = h + (_merge(xattn) @ layer["xwo"] + layer["xbo"])
+
+        m = layer_norm(h, layer["ln_mlp_w"], layer["ln_mlp_b"])
+        h = h + (jax.nn.gelu(m @ layer["fc1"] + layer["fc1_b"])
+                 @ layer["fc2"] + layer["fc2_b"])
+        return h, (kc, vc)
+
+    hidden, new_caches = jax.lax.scan(
+        scan_body, x, (lp, cross_k, cross_v, cache_k, cache_v))
+    hidden = layer_norm(hidden, params["dec_ln_w"], params["dec_ln_b"])
+    logits = _logits(params, hidden[:, -1], c)
+    return logits, new_caches[0], new_caches[1]
+
+
+def _transcribe_loop(params, c, b, first_tok, done0, cache_k, cache_v,
+                     cross_k, cross_v, max_tokens):
+    def step(carry, i):
+        tok, done, ck, cv = carry
+        lengths = jnp.broadcast_to(i + 1, (b,)).astype(jnp.int32)
+        logits, ck, cv = _decoder_step_kv(
+            params, tok, i + 1, cross_k, cross_v, c, ck, cv, lengths)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(done, c.eot_token, nxt)
+        return (nxt, done | (nxt == c.eot_token), ck, cv), tok
+
+    (_, done, _, _), toks = jax.lax.scan(
+        step, (first_tok, done0, cache_k, cache_v),
+        jnp.arange(max_tokens))
+    tokens = jnp.moveaxis(toks, 0, 1)  # [B, max_tokens]
+    lengths = jnp.sum(tokens != c.eot_token, axis=-1).astype(jnp.int32)
+    return tokens, lengths
+
+
+def transcribe_audio(params: dict, audio: jnp.ndarray,
+                     config: WhisperConfig, *,
+                     max_tokens: int = 64):
+    """PCM [B, T] -> (tokens, lengths): mel frontend + greedy decode in
+    one jittable graph (the ASR worker jits and buckets this)."""
+    mel = log_mel_spectrogram(audio, n_mels=config.n_mels,
+                              pad_to_frames=config.audio_frames)
+    return transcribe_greedy(params, mel, config, max_tokens=max_tokens)
+
+
+def param_count(params: dict) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))

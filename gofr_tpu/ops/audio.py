@@ -1,0 +1,97 @@
+"""Audio frontend: log-mel spectrogram, fully in JAX.
+
+The Whisper-family feature extractor (16 kHz PCM -> [frames, n_mels]
+log-mel), expressed as jittable array ops so it fuses into the encoder
+program and runs on the TPU instead of a host-side DSP library: framing
+is a gather, the STFT is ``jnp.fft.rfft`` over Hann-windowed frames,
+and the mel projection is one matmul (MXU) with a filterbank built
+once in numpy at trace time.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax.numpy as jnp
+
+SAMPLE_RATE = 16000
+N_FFT = 400
+HOP_LENGTH = 160
+CHUNK_SECONDS = 30
+
+
+def _hz_to_mel(hz):
+    return 2595.0 * np.log10(1.0 + np.asarray(hz) / 700.0)
+
+
+def _mel_to_hz(mel):
+    return 700.0 * (10.0 ** (np.asarray(mel) / 2595.0) - 1.0)
+
+
+@functools.lru_cache(maxsize=8)
+def mel_filterbank(n_mels: int = 80, n_fft: int = N_FFT,
+                   sample_rate: int = SAMPLE_RATE) -> np.ndarray:
+    """[n_fft//2+1, n_mels] triangular filters (HTK mel scale),
+    area-normalised per filter."""
+    n_bins = n_fft // 2 + 1
+    fft_freqs = np.linspace(0, sample_rate / 2, n_bins)
+    mel_points = np.linspace(_hz_to_mel(0.0), _hz_to_mel(sample_rate / 2),
+                             n_mels + 2)
+    hz_points = _mel_to_hz(mel_points)
+    bank = np.zeros((n_bins, n_mels), dtype=np.float32)
+    for m in range(n_mels):
+        left, center, right = hz_points[m], hz_points[m + 1], hz_points[m + 2]
+        up = (fft_freqs - left) / max(center - left, 1e-10)
+        down = (right - fft_freqs) / max(right - center, 1e-10)
+        tri = np.maximum(0.0, np.minimum(up, down))
+        norm = (right - left) / 2
+        bank[:, m] = tri / max(norm, 1e-10)
+    return bank
+
+
+def log_mel_spectrogram(audio: jnp.ndarray, *, n_mels: int = 80,
+                        n_fft: int = N_FFT, hop: int = HOP_LENGTH,
+                        sample_rate: int = SAMPLE_RATE,
+                        pad_to_frames: int | None = None) -> jnp.ndarray:
+    """PCM [T] or [B, T] float in [-1, 1] -> log-mel [B, frames, n_mels].
+
+    Matches the Whisper recipe: Hann window, power spectrum, mel
+    projection, ``log10`` clamped to 8 orders of dynamic range, scaled
+    to roughly [-1, 1]. ``pad_to_frames`` right-pads/truncates to a
+    fixed frame count so the encoder sees a static shape.
+    """
+    if audio.ndim == 1:
+        audio = audio[None, :]
+    b, t = audio.shape
+    audio = audio.astype(jnp.float32)
+
+    # reflect-pad half a window each side (librosa/whisper centering)
+    pad = n_fft // 2
+    audio = jnp.pad(audio, ((0, 0), (pad, pad)), mode="reflect")
+    n_frames = 1 + (audio.shape[1] - n_fft) // hop
+
+    idx = (jnp.arange(n_frames)[:, None] * hop
+           + jnp.arange(n_fft)[None, :])          # [frames, n_fft]
+    frames = audio[:, idx]                          # [B, frames, n_fft]
+    window = jnp.hanning(n_fft + 1)[:-1].astype(jnp.float32)
+    spectrum = jnp.fft.rfft(frames * window, n=n_fft, axis=-1)
+    power = jnp.abs(spectrum) ** 2                  # [B, frames, n_fft//2+1]
+
+    bank = jnp.asarray(mel_filterbank(n_mels, n_fft, sample_rate))
+    mel = power @ bank                              # [B, frames, n_mels]
+
+    log_mel = jnp.log10(jnp.maximum(mel, 1e-10))
+    log_mel = jnp.maximum(log_mel, log_mel.max(axis=(-2, -1),
+                                               keepdims=True) - 8.0)
+    log_mel = (log_mel + 4.0) / 4.0
+
+    if pad_to_frames is not None:
+        have = log_mel.shape[1]
+        if have < pad_to_frames:
+            log_mel = jnp.pad(
+                log_mel, ((0, 0), (0, pad_to_frames - have), (0, 0)))
+        else:
+            log_mel = log_mel[:, :pad_to_frames, :]
+    return log_mel

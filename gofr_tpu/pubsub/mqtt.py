@@ -39,6 +39,11 @@ class MQTTError(Exception):
     pass
 
 
+#: sentinel pushed into delivery queues when the connection dies so
+#: blocked consumers wake and raise instead of hanging forever
+_CLOSED = object()
+
+
 def encode_varint(n: int) -> bytes:
     out = bytearray()
     while True:
@@ -180,11 +185,37 @@ class MQTTClient:
             pass
         finally:
             self._connected = False
+            for queue in self._queues.values():
+                queue.put_nowait(_CLOSED)  # wake blocked consumers
+            dead = MQTTError("connection lost")
+            for fut in list(self._pending_acks.values()) \
+                    + list(self._suback.values()):
+                if not fut.done():
+                    fut.set_exception(dead)
+            self._pending_acks.clear()
+            self._suback.clear()
 
     def _require_writer(self) -> asyncio.StreamWriter:
         if self._writer is None or not self._connected:
             raise MQTTError("not connected")
         return self._writer
+
+    async def _reconnect(self) -> None:
+        """Drop dead state and redial; _queues is cleared so the next
+        subscribe() re-sends SUBSCRIBE for its filter."""
+        if self._read_task is not None:
+            self._read_task.cancel()
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+        self._queues.clear()
+        await self.connect()
+
+    async def _ensure_connected(self) -> None:
+        if not self._connected:
+            await self._reconnect()
 
     # ---------------------------------------------------------- publish
     async def publish(self, topic: str, value: bytes | str | dict,
@@ -193,6 +224,7 @@ class MQTTClient:
             value = json.dumps(value).encode()
         elif isinstance(value, str):
             value = value.encode()
+        await self._ensure_connected()
         writer = self._require_writer()
         start = time.perf_counter()
         flags = (self.qos << 1) | (1 if self.retain else 0)
@@ -206,7 +238,10 @@ class MQTTClient:
         writer.write(_packet(PUBLISH, flags, body + value))
         await writer.drain()
         if ack is not None:
-            await asyncio.wait_for(ack, timeout=10)
+            try:
+                await asyncio.wait_for(ack, timeout=10)
+            finally:
+                self._pending_acks.pop(packet_id, None)  # no leak on timeout
         if self.metrics is not None:
             self.metrics.increment_counter("app_pubsub_publish_total_count",
                                            topic=topic)
@@ -243,8 +278,14 @@ class MQTTClient:
     async def subscribe(self, topic: str, group: str = "default") -> Message:
         """MQTT has no queue groups; ``group`` is accepted for interface
         compatibility (shared subscriptions are MQTT 5)."""
+        await self._ensure_connected()
         queue = await self._ensure_sub(topic)
-        actual_topic, payload, packet_id = await queue.get()
+        item = await queue.get()
+        if item is _CLOSED:
+            # connection died while blocked; the subscriber runtime's
+            # backoff loop retries subscribe(), which reconnects
+            raise MQTTError("connection lost")
+        actual_topic, payload, packet_id = item
         if self.metrics is not None:
             self.metrics.increment_counter("app_pubsub_subscribe_total_count",
                                            topic=topic)

@@ -31,6 +31,11 @@ class NATSError(Exception):
     pass
 
 
+#: sentinel pushed into delivery queues when the connection dies so
+#: blocked consumers wake and raise instead of hanging forever
+_CLOSED = object()
+
+
 def subject_matches(pattern: str, subject: str) -> bool:
     """NATS subject matching: tokens split on '.', '*' matches one
     token, '>' matches the rest."""
@@ -127,11 +132,31 @@ class NATSClient:
             pass
         finally:
             self._connected = False
+            for queue in self._queues.values():
+                queue.put_nowait(_CLOSED)  # wake blocked consumers
 
     def _require_writer(self) -> asyncio.StreamWriter:
         if self._writer is None or not self._connected:
             raise NATSError("not connected")
         return self._writer
+
+    async def _reconnect(self) -> None:
+        """Drop dead state and redial; subscriptions re-issue on demand
+        (subscribe() finds _subs empty and SUBs again)."""
+        if self._read_task is not None:
+            self._read_task.cancel()
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+        self._subs.clear()
+        self._queues.clear()
+        await self.connect()
+
+    async def _ensure_connected(self) -> None:
+        if not self._connected:
+            await self._reconnect()
 
     # ---------------------------------------------------------- publish
     async def publish(self, topic: str, value: bytes | str | dict,
@@ -140,6 +165,7 @@ class NATSClient:
             value = json.dumps(value).encode()
         elif isinstance(value, str):
             value = value.encode()
+        await self._ensure_connected()
         writer = self._require_writer()
         start = time.perf_counter()
         writer.write(f"PUB {topic} {len(value)}\r\n".encode()
@@ -167,8 +193,14 @@ class NATSClient:
         return sid
 
     async def subscribe(self, topic: str, group: str = "default") -> Message:
+        await self._ensure_connected()
         sid = await self._ensure_sub(topic, group)
-        subject, payload = await self._queues[sid].get()
+        item = await self._queues[sid].get()
+        if item is _CLOSED:
+            # connection died while blocked; the subscriber runtime's
+            # backoff loop retries subscribe(), which reconnects
+            raise NATSError("connection lost")
+        subject, payload = item
         if self.metrics is not None:
             self.metrics.increment_counter("app_pubsub_subscribe_total_count",
                                            topic=topic)

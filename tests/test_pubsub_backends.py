@@ -123,6 +123,33 @@ class TestNATS:
             await server.close()
 
 
+    @async_test
+    async def test_connection_loss_wakes_consumer_and_reconnects(self):
+        from gofr_tpu.pubsub.nats import NATSError
+        server = MiniNATSServer()
+        await server.start()
+        port = server.port
+        client = NATSClient(port=port)
+        await client.connect()
+        task = asyncio.ensure_future(client.subscribe("t", ""))
+        await asyncio.sleep(0.05)
+        await server.close()  # broker dies while consumer is blocked
+        with pytest.raises(NATSError):
+            await asyncio.wait_for(task, timeout=3)  # wakes, no hang
+        # broker comes back on the same port: client self-heals
+        server2 = MiniNATSServer(port=port)
+        await server2.start()
+        try:
+            task2 = asyncio.ensure_future(client.subscribe("t", ""))
+            await asyncio.sleep(0.1)
+            await client.publish("t", b"back")
+            msg = await asyncio.wait_for(task2, timeout=3)
+            assert msg.value == b"back"
+        finally:
+            await client.close()
+            await server2.close()
+
+
 # ---------------------------------------------------------------------- MQTT
 class TestMQTT:
     @async_test

@@ -1,0 +1,190 @@
+"""Whisper-family ASR: audio frontend, model forward, greedy decode,
+batched worker over pub/sub (baseline config 4)."""
+
+import asyncio
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gofr_tpu.models.whisper import (WhisperConfig, param_count,
+                                     precompute_cross_kv, transcribe_audio,
+                                     transcribe_greedy, whisper_encode,
+                                     whisper_init)
+from gofr_tpu.ops.audio import log_mel_spectrogram, mel_filterbank
+from gofr_tpu.serving.asr import (ASRConfig, ASRWorker, Transcriber,
+                                  decode_audio_payload, make_asr_handler)
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return asyncio.run(fn(*args, **kwargs))
+    return wrapper
+
+
+CFG = WhisperConfig.tiny_test()
+PARAMS = whisper_init(jax.random.key(0), CFG)
+
+
+# ------------------------------------------------------------------- audio
+class TestAudioFrontend:
+    def test_mel_filterbank_shape_and_coverage(self):
+        bank = mel_filterbank(80)
+        assert bank.shape == (201, 80)
+        # every filter has mass; interior bins covered
+        assert (bank.sum(axis=0) > 0).all()
+
+    def test_log_mel_shapes_and_range(self):
+        t = 16000  # 1 s
+        audio = jnp.asarray(np.sin(np.linspace(0, 440 * 2 * np.pi, t)),
+                            jnp.float32)
+        mel = log_mel_spectrogram(audio, n_mels=80)
+        assert mel.ndim == 3 and mel.shape[0] == 1 and mel.shape[2] == 80
+        assert bool(jnp.isfinite(mel).all())
+        # whisper scaling keeps values in roughly [-1, 1.5]
+        assert float(mel.max()) < 2.0
+
+    def test_pad_to_frames_static_shape(self):
+        audio = jnp.zeros((2, 8000), jnp.float32)
+        mel = log_mel_spectrogram(audio, n_mels=8, pad_to_frames=64)
+        assert mel.shape == (2, 64, 8)
+
+    def test_jittable(self):
+        fn = jax.jit(lambda a: log_mel_spectrogram(a, n_mels=8,
+                                                   pad_to_frames=64))
+        out = fn(jnp.zeros((1, 4000), jnp.float32))
+        assert out.shape == (1, 64, 8)
+
+
+# ------------------------------------------------------------------- model
+class TestWhisperModel:
+    def test_param_tree_and_count(self):
+        assert param_count(PARAMS) > 0
+        assert PARAMS["enc_layers"]["wq"].shape[0] == CFG.n_audio_layers
+        assert PARAMS["dec_layers"]["xwk"].shape[0] == CFG.n_text_layers
+
+    def test_encode_shape(self):
+        mel = jnp.zeros((2, CFG.audio_frames, CFG.n_mels), jnp.float32)
+        enc = whisper_encode(PARAMS, mel, CFG)
+        assert enc.shape == (2, CFG.audio_ctx, CFG.dim)
+        assert bool(jnp.isfinite(enc).all())
+
+    def test_cross_kv_shapes(self):
+        mel = jnp.zeros((2, CFG.audio_frames, CFG.n_mels), jnp.float32)
+        enc = whisper_encode(PARAMS, mel, CFG)
+        ck, cv = precompute_cross_kv(PARAMS, enc, CFG)
+        assert ck.shape == (CFG.n_text_layers, 2, CFG.audio_ctx,
+                            CFG.n_heads, CFG.head_dim)
+        assert cv.shape == ck.shape
+
+    def test_greedy_transcribe_shapes_and_determinism(self):
+        mel = jax.random.normal(jax.random.key(1),
+                                (2, CFG.audio_frames, CFG.n_mels))
+        t1, l1 = transcribe_greedy(PARAMS, mel, CFG, max_tokens=8)
+        t2, l2 = transcribe_greedy(PARAMS, mel, CFG, max_tokens=8)
+        assert t1.shape == (2, 8)
+        assert np.array_equal(np.asarray(t1), np.asarray(t2))
+        assert np.array_equal(np.asarray(l1), np.asarray(l2))
+        assert (np.asarray(l1) <= 8).all()
+
+    def test_transcribe_audio_end_to_end_jits(self):
+        fn = jax.jit(lambda p, a: transcribe_audio(p, a, CFG, max_tokens=4))
+        audio = jnp.zeros((1, 6400), jnp.float32)  # pads to audio_frames
+        tokens, lengths = fn(PARAMS, audio)
+        assert tokens.shape == (1, 4)
+        assert int(lengths[0]) <= 4
+
+    def test_eot_freezes_sequence(self):
+        # rows past a sequence's EOT must all be EOT
+        mel = jax.random.normal(jax.random.key(2),
+                                (4, CFG.audio_frames, CFG.n_mels))
+        tokens, lengths = transcribe_greedy(PARAMS, mel, CFG, max_tokens=8)
+        tokens = np.asarray(tokens)
+        for row, n in zip(tokens, np.asarray(lengths)):
+            eots = row == CFG.eot_token
+            if eots.any():
+                first = int(np.argmax(eots))
+                assert eots[first:].all()
+
+    def test_presets(self):
+        assert WhisperConfig.whisper_large_v3().n_mels == 128
+        assert WhisperConfig.whisper_tiny().dim == 384
+
+
+# ----------------------------------------------------------------- serving
+def _tone(freq=220.0, seconds=0.25):
+    t = np.arange(int(16000 * seconds)) / 16000
+    return np.sin(2 * np.pi * freq * t).astype(np.float32)
+
+
+class TestTranscriber:
+    def test_bucketing_and_results(self):
+        tr = Transcriber(PARAMS, CFG, ASRConfig(max_batch=4, max_tokens=4,
+                                                sample_buckets=(8000, 16000)))
+        out = tr.transcribe_batch([_tone(), _tone(440.0)])
+        assert len(out) == 2
+        assert out[0]["batch"] == 2
+        assert out[0]["samples"] == 8000
+        assert all(r["n_tokens"] <= 4 for r in out)
+        assert tr.executions == 1
+
+    def test_payload_decoding(self):
+        import base64
+        pcm = _tone()
+        assert np.allclose(decode_audio_payload({"audio": pcm.tolist()}), pcm)
+        b64 = base64.b64encode(pcm.tobytes()).decode()
+        assert np.allclose(decode_audio_payload({"audio_b64": b64}), pcm)
+        with pytest.raises(ValueError):
+            decode_audio_payload({"nope": 1})
+
+    def test_http_handler(self):
+        tr = Transcriber(PARAMS, CFG, ASRConfig(max_batch=1, max_tokens=4,
+                                                sample_buckets=(8000,)))
+
+        class Ctx:
+            def bind(self):
+                return {"audio": _tone().tolist()}
+        result = make_asr_handler(tr)(Ctx())
+        assert "tokens" in result and result["n_tokens"] <= 4
+
+
+class TestASRWorker:
+    @async_test
+    async def test_batch_consume_publish_commit(self):
+        from gofr_tpu.pubsub.inmemory import InMemoryBroker
+        broker = InMemoryBroker()
+        tr = Transcriber(PARAMS, CFG, ASRConfig(max_batch=4, max_tokens=4,
+                                                sample_buckets=(8000,)))
+        worker = ASRWorker(tr, broker)
+        for i in range(3):
+            await broker.publish("asr.requests",
+                                 {"request_id": f"r{i}",
+                                  "audio": _tone(200.0 + i).tolist()})
+        handled = await worker.run_once()
+        assert handled == 3
+        assert tr.executions == 1  # one device batch for all three
+        results = [await broker.subscribe("asr.results") for _ in range(3)]
+        ids = {r.bind()["request_id"] for r in results}
+        assert ids == {"r0", "r1", "r2"}
+        # everything committed: no redelivery pending
+        assert broker.redeliver_uncommitted("asr.requests", "asr-workers") == 0
+
+    @async_test
+    async def test_poison_message_dropped(self):
+        from gofr_tpu.pubsub.inmemory import InMemoryBroker
+        broker = InMemoryBroker()
+        tr = Transcriber(PARAMS, CFG, ASRConfig(max_batch=2, max_tokens=4,
+                                                sample_buckets=(8000,)))
+        worker = ASRWorker(tr, broker)
+        await broker.publish("asr.requests", {"garbage": True})
+        await broker.publish("asr.requests",
+                             {"request_id": "ok", "audio": _tone().tolist()})
+        handled = await worker.run_once()
+        assert handled == 1
+        result = await broker.subscribe("asr.results")
+        assert result.bind()["request_id"] == "ok"
+        assert broker.redeliver_uncommitted("asr.requests", "asr-workers") == 0
