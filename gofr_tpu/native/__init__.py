@@ -1,0 +1,16 @@
+"""Native (C++) runtime components.
+
+The reference framework is pure Go; this build's runtime-side hot
+paths are C++ compiled on demand (build.py) with pure-Python fallbacks
+so nothing ever *requires* a toolchain:
+
+- :mod:`.bpe` — byte-pair tokenizer merge loop (serving admission).
+- :mod:`.batch_queue` — waitable MPMC batch queue (continuous-batching
+  admission; blocking pops release the GIL).
+
+The TPU compute path stays JAX/XLA/Pallas — the native layer is the
+host runtime around it, mirroring how the reference keeps its runtime
+(routers, schedulers, IO) in its systems language.
+"""
+
+from .build import NativeBuildError, available, compiler  # noqa: F401

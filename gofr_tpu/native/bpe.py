@@ -1,0 +1,51 @@
+"""ctypes binding for the C++ BPE encoder (src/bpe.cpp).
+
+``load(ranks)`` builds a native encoder from a ``bytes -> rank`` table;
+``NativeBPE.encode`` releases the GIL for the merge loop. Raises
+``NativeBuildError`` when no compiler is available — the caller
+(serving/tokenizer.py) falls back to pure Python.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+from .build import load_library
+
+
+class NativeBPE:
+    def __init__(self, ranks: dict[bytes, int]) -> None:
+        self._lib = load_library("bpe")
+        self._lib.bpe_create.restype = ctypes.c_void_p
+        self._lib.bpe_add_token.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int32]
+        self._lib.bpe_encode.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+        self._lib.bpe_encode.restype = ctypes.c_int
+        self._lib.bpe_destroy.argtypes = [ctypes.c_void_p]
+        self._lib.bpe_finalize.argtypes = [ctypes.c_void_p]
+        self._handle = ctypes.c_void_p(self._lib.bpe_create())
+        for token, rank in ranks.items():
+            self._lib.bpe_add_token(self._handle, token, len(token), rank)
+        self._lib.bpe_finalize(self._handle)
+
+    def encode(self, data: bytes) -> list[int]:
+        cap = max(len(data), 16)
+        out = (ctypes.c_int32 * cap)()
+        n = self._lib.bpe_encode(self._handle, data, len(data), out, cap)
+        if n < 0:  # output overflow cannot happen with cap >= len, but be safe
+            cap *= 4
+            out = (ctypes.c_int32 * cap)()
+            n = self._lib.bpe_encode(self._handle, data, len(data), out, cap)
+        return list(out[:max(n, 0)])
+
+    def __del__(self) -> None:
+        lib = getattr(self, "_lib", None)
+        handle = getattr(self, "_handle", None)
+        if lib is not None and handle:
+            lib.bpe_destroy(handle)
+
+
+def load(ranks: dict[bytes, int]) -> NativeBPE:
+    return NativeBPE(ranks)

@@ -1,0 +1,84 @@
+"""Native build: compile the C++ runtime sources with g++ on demand.
+
+The reference is pure Go compiled ahead of time; our native runtime
+pieces (BPE tokenizer, batch queue) compile once per machine into a
+content-addressed cache (``~/.cache/gofr_tpu/``) the first time they
+are imported, and every consumer falls back to pure Python when no
+compiler is present — CI and tests never require a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+import tempfile
+from pathlib import Path
+
+SRC_DIR = Path(__file__).parent / "src"
+
+_loaded: dict[str, ctypes.CDLL] = {}
+
+
+class NativeBuildError(Exception):
+    pass
+
+
+def _cache_dir() -> Path:
+    root = os.environ.get("GOFR_NATIVE_CACHE",
+                          os.path.join(os.path.expanduser("~"),
+                                       ".cache", "gofr_tpu"))
+    path = Path(root)
+    path.mkdir(parents=True, exist_ok=True)
+    return path
+
+
+def compiler() -> str | None:
+    for cc in (os.environ.get("CXX"), "g++", "clang++"):
+        if cc and shutil.which(cc):
+            return cc
+    return None
+
+
+def load_library(name: str) -> ctypes.CDLL:
+    """Compile (if needed) and dlopen ``src/<name>.cpp``."""
+    if name in _loaded:
+        return _loaded[name]
+    if os.environ.get("GOFR_NATIVE", "1").lower() in ("0", "false", "off"):
+        raise NativeBuildError("native code disabled via GOFR_NATIVE")
+    source = SRC_DIR / f"{name}.cpp"
+    if not source.is_file():
+        raise NativeBuildError(f"missing source {source}")
+    cc = compiler()
+    if cc is None:
+        raise NativeBuildError("no C++ compiler on PATH")
+
+    code = source.read_bytes()
+    digest = hashlib.sha256(code).hexdigest()[:16]
+    lib_path = _cache_dir() / f"{name}-{digest}.so"
+    if not lib_path.is_file():
+        # compile to a temp file then atomic-rename: concurrent workers
+        # racing the first build must never dlopen a half-written .so
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(lib_path.parent))
+        os.close(fd)
+        cmd = [cc, "-O3", "-std=c++17", "-shared", "-fPIC",
+               str(source), "-o", tmp]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+        if proc.returncode != 0:
+            os.unlink(tmp)
+            raise NativeBuildError(
+                f"{cc} failed for {name}: {proc.stderr[-2000:]}")
+        os.replace(tmp, lib_path)
+    _loaded[name] = ctypes.CDLL(str(lib_path))
+    return _loaded[name]
+
+
+def available(name: str) -> bool:
+    try:
+        load_library(name)
+        return True
+    except NativeBuildError:
+        return False

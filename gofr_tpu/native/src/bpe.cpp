@@ -1,0 +1,151 @@
+// Byte-pair encoder — the host-side tokenization hot path.
+//
+// The serving engine tokenizes every request on the CPU before the
+// device sees it; in Python the greedy merge loop dominates request
+// admission at high QPS. This implements the classic rank-based BPE
+// merge with a doubly-linked part list + lazy min-heap: O(n log n)
+// over the text instead of the O(n^2) scan of the Python fallback
+// (gofr_tpu/serving/tokenizer.py:_bpe_merge), called through ctypes
+// (which releases the GIL, so tokenization overlaps device steps).
+//
+// C ABI:
+//   bpe_create() -> handle
+//   bpe_add_token(handle, bytes, len, rank)   // build vocabulary
+//   bpe_finalize(handle)                      // index pairs
+//   bpe_encode(handle, text, len, out, cap) -> n tokens (or -1 overflow)
+//   bpe_destroy(handle)
+
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Encoder {
+    std::unordered_map<std::string, int32_t> ranks;
+};
+
+struct Part {
+    uint32_t start;   // byte offset into the text
+    uint32_t len;     // current token length in bytes
+    int32_t prev;     // index of previous live part, -1 at head
+    int32_t next;     // index of next live part, -1 at tail
+    uint64_t version; // bumped on every merge touching this part
+    bool alive;
+};
+
+struct HeapEntry {
+    int32_t rank;
+    int32_t left;           // part index
+    uint64_t left_version;  // staleness: left part grew since push
+    uint32_t right_start;   // staleness: right partner replaced
+    uint64_t right_version; // staleness: right partner grew since push
+    bool operator>(const HeapEntry& o) const {
+        if (rank != o.rank) return rank > o.rank;
+        return left > o.left; // deterministic leftmost-first tie-break
+    }
+};
+
+int32_t pair_rank(const Encoder* e, const uint8_t* text, const Part& a,
+                  const Part& b) {
+    std::string key(reinterpret_cast<const char*>(text + a.start),
+                    a.len + b.len);
+    auto it = e->ranks.find(key);
+    return it == e->ranks.end() ? -1 : it->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* bpe_create() { return new Encoder(); }
+
+void bpe_add_token(void* h, const uint8_t* bytes, int len, int32_t rank) {
+    auto* e = static_cast<Encoder*>(h);
+    e->ranks.emplace(std::string(reinterpret_cast<const char*>(bytes), len),
+                     rank);
+}
+
+void bpe_finalize(void*) {}  // reserved for a future pair index
+
+int bpe_encode(void* h, const uint8_t* text, int len, int32_t* out,
+               int out_cap) {
+    auto* e = static_cast<Encoder*>(h);
+    if (len <= 0) return 0;
+
+    std::vector<Part> parts(len);
+    for (int i = 0; i < len; ++i) {
+        parts[i] = {static_cast<uint32_t>(i), 1, i - 1,
+                    i + 1 < len ? i + 1 : -1, 0, true};
+    }
+
+    std::priority_queue<HeapEntry, std::vector<HeapEntry>,
+                        std::greater<HeapEntry>> heap;
+    for (int i = 0; i + 1 < len; ++i) {
+        int32_t r = pair_rank(e, text, parts[i], parts[i + 1]);
+        if (r >= 0) heap.push({r, i, 0, parts[i + 1].start, 0});
+    }
+
+    while (!heap.empty()) {
+        HeapEntry top = heap.top();
+        heap.pop();
+        Part& a = parts[top.left];
+        // exact identity: both sides unchanged since the entry was
+        // pushed (either side growing through a merge bumps its version)
+        if (!a.alive || a.version != top.left_version || a.next < 0)
+            continue;
+        Part& b = parts[a.next];
+        if (b.start != top.right_start || b.version != top.right_version)
+            continue;
+
+        // merge b into a
+        a.len += b.len;
+        a.version++;
+        b.alive = false;
+        a.next = b.next;
+        if (b.next >= 0) parts[b.next].prev = top.left;
+
+        if (a.prev >= 0) {
+            Part& p = parts[a.prev];
+            int32_t pr = pair_rank(e, text, p, a);
+            if (pr >= 0)
+                heap.push({pr, a.prev, p.version, a.start, a.version});
+        }
+        if (a.next >= 0) {
+            Part& n = parts[a.next];
+            int32_t nr = pair_rank(e, text, a, n);
+            if (nr >= 0)
+                heap.push({nr, top.left, a.version, n.start, n.version});
+        }
+    }
+
+    int n = 0;
+    for (int i = 0; i >= 0; i = parts[i].next) {
+        const Part& p = parts[i];
+        std::string key(reinterpret_cast<const char*>(text + p.start), p.len);
+        auto it = e->ranks.find(key);
+        if (it != e->ranks.end()) {
+            if (n >= out_cap) return -1;
+            out[n++] = it->second;
+        } else {
+            // unmergeable span without a rank: emit known single bytes
+            for (uint32_t j = 0; j < p.len; ++j) {
+                std::string one(reinterpret_cast<const char*>(
+                                    text + p.start + j), 1);
+                auto bit = e->ranks.find(one);
+                if (bit != e->ranks.end()) {
+                    if (n >= out_cap) return -1;
+                    out[n++] = bit->second;
+                }
+            }
+        }
+    }
+    return n;
+}
+
+void bpe_destroy(void* h) { delete static_cast<Encoder*>(h); }
+
+}  // extern "C"

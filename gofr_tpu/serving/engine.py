@@ -29,7 +29,7 @@ allocator can replace it behind the same interface.
 from __future__ import annotations
 
 import asyncio
-import queue as queue_mod
+
 import threading
 import time
 from dataclasses import dataclass, field
@@ -121,7 +121,10 @@ class Engine:
         self.k_cache, self.v_cache = make_cache(cfg.max_batch, cfg.max_seq)
         self.lengths = np.zeros(cfg.max_batch, np.int32)       # kv length per slot
         self.active: list[GenRequest | None] = [None] * cfg.max_batch
-        self.waiting: queue_mod.Queue[GenRequest] = queue_mod.Queue()
+        # admission queue: C++ waitable batch queue when a toolchain
+        # exists (gofr_tpu/native), queue.Queue-semantics fallback
+        from ..native.batch_queue import new_request_queue
+        self.waiting = new_request_queue()
 
         self._rng = jax.random.key(int(time.time() * 1e3) % (2**31))
         self._running = False
@@ -176,8 +179,10 @@ class Engine:
         except RuntimeError:  # submitted from a plain thread (tests/bench)
             req.loop = None
             req.out_queue = None
-        self.waiting.put(req)
-        self._wake.set()
+        if not self.waiting.put(req):  # full/closed: fail loudly, never hang
+            req.error = "engine not accepting requests"
+            req.finished_at = time.time()
+            req._emit(None)
         return req
 
     def submit_sync(self, prompt_tokens: list[int],
@@ -218,14 +223,14 @@ class Engine:
                 return i
         return -1
 
-    def _admit_one(self) -> bool:
+    def _admit(self, req: GenRequest) -> None:
         slot = self._free_slot()
-        if slot < 0:
-            return False
-        try:
-            req = self.waiting.get_nowait()
-        except queue_mod.Empty:
-            return False
+        if slot < 0:  # raced; requeue for the next pass
+            if not self.waiting.put(req):
+                req.error = "engine not accepting requests"
+                req.finished_at = time.time()
+                req._emit(None)
+            return
         try:
             self._prefill_into_slot(req, slot)
         except Exception as exc:
@@ -234,7 +239,6 @@ class Engine:
             req._emit(None)
             if self.logger:
                 self.logger.error(f"prefill failed: {exc!r}")
-        return True
 
     def _prefill_into_slot(self, req: GenRequest, slot: int) -> None:
         n = len(req.prompt_tokens)
@@ -331,16 +335,20 @@ class Engine:
     # ---------------------------------------------------------------- loop
     def _loop(self) -> None:
         while self._running:
-            did_work = False
-            # admit as many waiting prefills as slots allow (TTFT priority)
-            while self._admit_one():
-                did_work = True
+            free = sum(1 for r in self.active if r is None)
+            busy = free < self.config.max_batch
+            if free > 0:
+                # one batched pop per pass (TTFT priority): blocks while
+                # fully idle — in the native queue the engine thread
+                # sleeps in C with the GIL released — and is a zero-wait
+                # drain between decode steps while busy
+                batch = self.waiting.pop_batch(
+                    free, first_wait_s=0.0 if busy else 0.05,
+                    drain_wait_s=0.0)
+                for req in batch or []:
+                    self._admit(req)
             if any(r is not None for r in self.active):
                 self._decode_step()
-                did_work = True
-            if not did_work:
-                self._wake.clear()
-                self._wake.wait(timeout=0.1)
 
 
 def _sample_batch(logits: jnp.ndarray, key: jax.Array,

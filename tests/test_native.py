@@ -1,0 +1,178 @@
+"""Native C++ runtime layer: build system, BPE encoder, batch queue.
+
+Parity-style tests: the native BPE must produce exactly the pure-Python
+fallback's tokenization, and the native queue must behave like the
+Python fallback — both are exercised with the same assertions.
+"""
+
+import threading
+import time
+
+import pytest
+
+from gofr_tpu.native import available, compiler
+from gofr_tpu.native.batch_queue import (PyRequestQueue, RequestQueue,
+                                         new_request_queue)
+from gofr_tpu.serving.tokenizer import BPETokenizer
+
+HAVE_CC = compiler() is not None
+needs_cc = pytest.mark.skipif(not HAVE_CC, reason="no C++ compiler")
+
+
+def _ranks() -> dict[bytes, int]:
+    """Byte vocabulary + some merges, tiktoken-style ascending ranks."""
+    ranks = {bytes([i]): i for i in range(256)}
+    nxt = 256
+    for merge in [b"th", b"he", b"in", b"er", b"the", b" t", b" the",
+                  b"to", b"ke", b"en", b"tok", b"token", b"iz", b"ize"]:
+        ranks[merge] = nxt
+        nxt += 1
+    return ranks
+
+
+@needs_cc
+class TestNativeBPE:
+    def test_builds(self):
+        assert available("bpe")
+
+    def test_matches_python_fallback_exactly(self):
+        tok = BPETokenizer(_ranks())
+        assert tok._native is not None, "native path should have loaded"
+        texts = ["the tokenizer tokenizes the token",
+                 "hello world", "", "a", "  ", "thththththth",
+                 "ünïcödé — emoji 🎉 bytes", "x" * 500]
+        for text in texts:
+            data = text.encode("utf-8")
+            assert tok._native.encode(data) == tok._bpe_merge(data), text
+
+    def test_parity_fuzz(self):
+        """Random byte soup over the merge alphabet — catches stale-
+        heap-entry divergence the curated texts missed."""
+        import random
+        rng = random.Random(7)
+        tok = BPETokenizer(_ranks())
+        for trial in range(150):
+            data = bytes(rng.choices(b"thein erko z the token",
+                                     k=rng.randint(0, 300)))
+            assert tok._native.encode(data) == tok._bpe_merge(data), \
+                (trial, data)
+
+    def test_roundtrip_through_tokenizer(self):
+        tok = BPETokenizer(_ranks())
+        text = "the token in the tokenizer"
+        ids = tok.encode(text, bos=False)
+        assert tok.decode(ids) == text
+        # merges actually happened (fewer tokens than bytes)
+        assert len(ids) < len(text.encode())
+
+    def test_long_text_fast(self):
+        tok = BPETokenizer(_ranks())
+        text = "the tokenizer tokenizes the token " * 2000  # ~68KB
+        start = time.perf_counter()
+        ids = tok._native.encode(text.encode())
+        elapsed = time.perf_counter() - start
+        assert tok.decode(ids) == text
+        assert elapsed < 2.0  # heap merge, not O(n^2)
+
+
+@needs_cc
+class TestNativeQueueBuilds:
+    def test_new_request_queue_is_native(self):
+        q = new_request_queue()
+        assert isinstance(q, RequestQueue)
+
+
+@pytest.mark.parametrize("make", [
+    pytest.param(lambda: RequestQueue(), id="native",
+                 marks=needs_cc),
+    pytest.param(lambda: PyRequestQueue(), id="python"),
+])
+class TestRequestQueueSemantics:
+    def test_put_pop_order(self, make):
+        q = make()
+        for i in range(5):
+            assert q.put(f"r{i}")
+        assert q.qsize() == 5
+        batch = q.pop_batch(3, first_wait_s=0.1)
+        assert batch == ["r0", "r1", "r2"]
+        assert q.pop_batch(10, first_wait_s=0.1) == ["r3", "r4"]
+
+    def test_timeout_returns_empty(self, make):
+        q = make()
+        start = time.perf_counter()
+        assert q.pop_batch(4, first_wait_s=0.05) == []
+        assert time.perf_counter() - start < 1.0
+
+    def test_close_returns_none_after_drain(self, make):
+        q = make()
+        q.put("last")
+        q.close()
+        assert q.pop_batch(4, first_wait_s=0.05) == ["last"]
+        assert q.pop_batch(4, first_wait_s=0.05) is None
+
+    def test_blocking_pop_wakes_on_push(self, make):
+        q = make()
+        got = []
+
+        def consumer():
+            got.extend(q.pop_batch(4, first_wait_s=5.0) or [])
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.05)
+        q.put("wake")
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert got == ["wake"]
+
+    def test_drain_window_coalesces_stragglers(self, make):
+        q = make()
+        q.put("a")
+
+        def late_producer():
+            time.sleep(0.03)
+            q.put("b")
+
+        t = threading.Thread(target=late_producer)
+        t.start()
+        batch = q.pop_batch(4, first_wait_s=0.5, drain_wait_s=0.3)
+        t.join()
+        assert batch == ["a", "b"]  # straggler joined the same batch
+
+    def test_get_nowait_compat(self, make):
+        import queue as queue_mod
+        q = make()
+        q.put("x")
+        assert q.get_nowait() == "x"
+        with pytest.raises(queue_mod.Empty):
+            q.get_nowait()
+
+    def test_many_producers_one_consumer(self, make):
+        q = make()
+        n_producers, per = 8, 50
+
+        def producer(base):
+            for i in range(per):
+                q.put(base + i)
+
+        threads = [threading.Thread(target=producer, args=(k * 1000,))
+                   for k in range(n_producers)]
+        for t in threads:
+            t.start()
+        seen = []
+        deadline = time.time() + 10
+        while len(seen) < n_producers * per and time.time() < deadline:
+            seen.extend(q.pop_batch(64, first_wait_s=0.5) or [])
+        for t in threads:
+            t.join()
+        assert len(seen) == n_producers * per
+        assert len(set(seen)) == n_producers * per  # no dups, no losses
+
+
+def test_engine_uses_request_queue():
+    """The serving engine's admission queue is the native-or-fallback
+    request queue (compatible with its queue.Queue-era API)."""
+    from gofr_tpu.serving.glue import demo_llama_engine
+    engine = demo_llama_engine()
+    assert hasattr(engine.waiting, "pop_batch")
+    assert engine.waiting.qsize() == 0
