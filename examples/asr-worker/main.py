@@ -1,0 +1,45 @@
+"""ASR batch worker (baseline config 4): Whisper transcription pulled
+from pub/sub in device-sized batches + an interactive /transcribe
+endpoint. No reference counterpart — this is the TPU-native analog of
+a GoFr subscriber app.
+"""
+
+import asyncio
+
+from gofr_tpu.app import App, new_app
+
+
+def build_app(config=None) -> App:
+    import jax
+    from gofr_tpu.models.whisper import WhisperConfig, whisper_init
+    from gofr_tpu.serving.asr import (ASRConfig, ASRWorker, Transcriber,
+                                      make_asr_handler)
+
+    app = new_app() if config is None else App(config=config)
+    if app.container.pubsub is None:
+        from gofr_tpu.pubsub.inmemory import InMemoryBroker
+        app.container.add_pubsub(InMemoryBroker(
+            logger=app.logger, metrics=app.container.metrics))
+
+    preset = getattr(WhisperConfig,
+                     app.config.get_or_default("MODEL_PRESET", "tiny_test"))
+    model_config = preset()
+    params = whisper_init(jax.random.key(0), model_config)
+    transcriber = Transcriber(params, model_config,
+                              ASRConfig(max_batch=4, max_tokens=16,
+                                        sample_buckets=(16000, 80000)))
+    app.container.add_model("whisper", transcriber)
+    app.post("/transcribe", make_asr_handler(transcriber))
+
+    worker = ASRWorker(transcriber, app.container.pubsub)
+    app.state_worker = worker  # exposed for tests/inspection
+
+    @app.on_start
+    def start_worker(container):
+        app._tasks.append(asyncio.ensure_future(worker.run()))
+
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

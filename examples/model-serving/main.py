@@ -1,0 +1,31 @@
+"""TPU model serving — the flagship path with no reference
+counterpart: a Llama-family model behind /chat with continuous
+batching, TTFT metrics, and health showing engine state.
+
+Uses the tiny config by default so it runs anywhere; set
+MODEL_PRESET=llama3_1b (etc.) on real hardware.
+"""
+
+from gofr_tpu.app import App, new_app
+
+
+def build_app(config=None) -> App:
+    import jax
+    from gofr_tpu.models.llama import LlamaConfig, llama_init
+    from gofr_tpu.serving.engine import EngineConfig
+    from gofr_tpu.serving.glue import llama_engine
+
+    app = new_app() if config is None else App(config=config)
+    preset = getattr(LlamaConfig,
+                     app.config.get_or_default("MODEL_PRESET", "tiny"))
+    model_config = preset()
+    params = llama_init(jax.random.key(0), model_config)
+    engine = llama_engine(params, model_config,
+                          EngineConfig(max_batch=4,
+                                       max_seq=model_config.max_seq))
+    app.serve_model("llama", engine)  # POST /chat + health + lifecycle
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -34,16 +34,21 @@ class Out:
 
     # ------------------------------------------------------------ writes
     def write(self, text: str) -> None:
-        self.stream.write(text)
+        # `tool help | head` closes the pipe mid-output; exit quietly
+        # like every well-behaved CLI instead of tracebacking
+        try:
+            self.stream.write(text)
+        except BrokenPipeError:
+            raise SystemExit(0)
 
     def print(self, *values: object, sep: str = " ", end: str = "\n") -> None:
-        self.stream.write(sep.join(str(v) for v in values) + end)
+        self.write(sep.join(str(v) for v in values) + end)
 
     def println(self, *values: object) -> None:
         self.print(*values)
 
     def printf(self, fmt: str, *args: object) -> None:
-        self.stream.write(fmt % args if args else fmt)
+        self.write(fmt % args if args else fmt)
 
     def _colored(self, text: str, code: int) -> str:
         if not self.is_tty:

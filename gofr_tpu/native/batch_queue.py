@@ -128,6 +128,12 @@ class PyRequestQueue:
     def pop_batch(self, max_n: int, first_wait_s: float = 0.1,
                   drain_wait_s: float = 0.0) -> list[Any] | None:
         out: list[Any] = []
+        # grab already-queued work even at zero wait (the engine's busy
+        # path polls with first_wait_s=0.0 between decode steps)
+        try:
+            out.append(self._q.get_nowait())
+        except queue_mod.Empty:
+            pass
         deadline = time.monotonic() + first_wait_s
         while not out:
             if self._closed and self._q.empty():

@@ -57,22 +57,35 @@ def load_library(name: str) -> ctypes.CDLL:
 
     code = source.read_bytes()
     digest = hashlib.sha256(code).hexdigest()[:16]
-    lib_path = _cache_dir() / f"{name}-{digest}.so"
-    if not lib_path.is_file():
-        # compile to a temp file then atomic-rename: concurrent workers
-        # racing the first build must never dlopen a half-written .so
-        fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(lib_path.parent))
-        os.close(fd)
-        cmd = [cc, "-O3", "-std=c++17", "-shared", "-fPIC",
-               str(source), "-o", tmp]
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=120)
-        if proc.returncode != 0:
-            os.unlink(tmp)
-            raise NativeBuildError(
-                f"{cc} failed for {name}: {proc.stderr[-2000:]}")
-        os.replace(tmp, lib_path)
-    _loaded[name] = ctypes.CDLL(str(lib_path))
+    try:
+        lib_path = _cache_dir() / f"{name}-{digest}.so"
+        if not lib_path.is_file():
+            # compile to a temp file then atomic-rename: concurrent
+            # workers racing the first build must never dlopen a
+            # half-written .so
+            fd, tmp = tempfile.mkstemp(suffix=".so",
+                                       dir=str(lib_path.parent))
+            os.close(fd)
+            try:
+                cmd = [cc, "-O3", "-std=c++17", "-shared", "-fPIC",
+                       str(source), "-o", tmp]
+                proc = subprocess.run(cmd, capture_output=True, text=True,
+                                      timeout=120)
+                if proc.returncode != 0:
+                    raise NativeBuildError(
+                        f"{cc} failed for {name}: {proc.stderr[-2000:]}")
+                os.replace(tmp, lib_path)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+        _loaded[name] = ctypes.CDLL(str(lib_path))
+    except NativeBuildError:
+        raise
+    except Exception as exc:
+        # unwritable cache dir, compile timeout, corrupt cached .so —
+        # all must surface as NativeBuildError so callers can fall back
+        raise NativeBuildError(f"native build of {name} failed: {exc!r}") \
+            from exc
     return _loaded[name]
 
 

@@ -30,11 +30,21 @@ struct BatchQueue {
     std::mutex mu;
     std::condition_variable not_empty;
     std::condition_variable not_full;
+    std::condition_variable drained;  // destroy handshake
     std::deque<uint64_t> items;
     size_t capacity;
+    int waiters = 0;  // threads inside a blocking wait
     bool closed = false;
 
     explicit BatchQueue(size_t cap) : capacity(cap) {}
+};
+
+struct WaiterGuard {
+    BatchQueue* q;  // mu must be held at construction and destruction
+    explicit WaiterGuard(BatchQueue* queue) : q(queue) { q->waiters++; }
+    ~WaiterGuard() {
+        if (--q->waiters == 0) q->drained.notify_all();
+    }
 };
 
 }  // namespace
@@ -57,24 +67,11 @@ int bq_push(void* h, uint64_t item) {
     return 0;
 }
 
-int bq_push_wait(void* h, uint64_t item, long wait_us) {
-    auto* q = static_cast<BatchQueue*>(h);
-    std::unique_lock<std::mutex> lock(q->mu);
-    if (!q->not_full.wait_for(lock, std::chrono::microseconds(wait_us),
-                              [q] { return q->closed ||
-                                           q->items.size() < q->capacity; }))
-        return -1;  // timed out still full
-    if (q->closed) return -2;
-    q->items.push_back(item);
-    lock.unlock();
-    q->not_empty.notify_one();
-    return 0;
-}
-
 long bq_pop_batch(void* h, uint64_t* out, long max_n, long first_wait_us,
                   long drain_wait_us) {
     auto* q = static_cast<BatchQueue*>(h);
     std::unique_lock<std::mutex> lock(q->mu);
+    WaiterGuard guard(q);
     if (q->items.empty() && !q->closed) {
         q->not_empty.wait_for(lock, std::chrono::microseconds(first_wait_us),
                               [q] { return !q->items.empty() || q->closed; });
@@ -120,6 +117,18 @@ void bq_close(void* h) {
     q->not_full.notify_all();
 }
 
-void bq_destroy(void* h) { delete static_cast<BatchQueue*>(h); }
+void bq_destroy(void* h) {
+    auto* q = static_cast<BatchQueue*>(h);
+    {
+        // close, wake everyone, and wait for blocked poppers to leave
+        // before freeing the mutex/cvs they are waiting on
+        std::unique_lock<std::mutex> lock(q->mu);
+        q->closed = true;
+        q->not_empty.notify_all();
+        q->not_full.notify_all();
+        q->drained.wait(lock, [q] { return q->waiters == 0; });
+    }
+    delete q;
+}
 
 }  // extern "C"

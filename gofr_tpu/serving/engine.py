@@ -29,7 +29,6 @@ allocator can replace it behind the same interface.
 from __future__ import annotations
 
 import asyncio
-
 import threading
 import time
 from dataclasses import dataclass, field
@@ -81,7 +80,6 @@ class EngineConfig:
     max_seq: int = 1024         # per-slot kv capacity
     prefill_buckets: tuple = (32, 64, 128, 256, 512, 1024)
     eos_id: int = -1            # -1: never stop on eos
-    idle_sleep_s: float = 0.001
 
 
 class Engine:
@@ -128,7 +126,6 @@ class Engine:
 
         self._rng = jax.random.key(int(time.time() * 1e3) % (2**31))
         self._running = False
-        self._wake = threading.Event()
         self._thread: threading.Thread | None = None
         self._step_count = 0
         self.total_generated = 0
@@ -144,10 +141,17 @@ class Engine:
 
     def stop(self) -> None:
         self._running = False
-        self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        # terminal: refuse new submissions and fail anything stranded in
+        # the queue so no submitter waits on a request nothing will run
+        self.waiting.close()
+        stranded = self.waiting.pop_batch(1 << 16, first_wait_s=0.0)
+        for req in stranded or []:
+            req.error = "engine stopped"
+            req.finished_at = time.time()
+            req._emit(None)
 
     def health_check(self) -> dict:
         return {
