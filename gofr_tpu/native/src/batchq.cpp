@@ -96,7 +96,9 @@ long bq_pop_batch(void* h, uint64_t* out, long max_n, long first_wait_us,
             break;
         grab();
     }
-    lock.unlock();
+    // notify under the lock and let WaiterGuard destruct while it is
+    // still held — an early unlock would decrement waiters/notify
+    // drained unsynchronized, racing bq_destroy into use-after-free
     q->not_full.notify_all();
     return n;
 }

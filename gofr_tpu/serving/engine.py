@@ -45,7 +45,7 @@ NEG_INF = -1e30
 class SamplingParams:
     temperature: float = 0.7
     top_p: float = 1.0
-    top_k: int = 0          # 0 = disabled (static per engine, not per req)
+    top_k: int = 0          # 0 = disabled
     max_new_tokens: int = 128
 
 
@@ -80,6 +80,12 @@ class EngineConfig:
     max_seq: int = 1024         # per-slot kv capacity
     prefill_buckets: tuple = (32, 64, 128, 256, 512, 1024)
     eos_id: int = -1            # -1: never stop on eos
+    #: decode steps fused into one device call (lax.scan). Each host
+    #: round-trip then yields K tokens per slot instead of 1 — the
+    #: per-token host/dispatch overhead divides by K. Tokens stream in
+    #: bursts of K and admission happens between passes, so large K
+    #: trades TTFT/streaming granularity for throughput.
+    decode_steps_per_pass: int = 4
 
 
 class Engine:
@@ -103,7 +109,38 @@ class Engine:
         self._make_cache = make_cache
 
         cfg = config
-        self._decode = jax.jit(decode_fn, donate_argnums=(2, 3))
+
+        # decode + sampling fused into ONE graph returning just the
+        # sampled token ids [B] — the per-step host transfer is 4B/slot
+        # instead of the full [B, vocab] logits, and none of the
+        # sampling math dispatches eagerly (each eager op is a host
+        # round-trip, ruinous over a device tunnel)
+        base_key = jax.random.key(int(time.time() * 1e3) % (2**31))
+        # disjoint rng streams: prefill and decode fold into separate
+        # subkeys so their per-step indices can never collide
+        decode_key = jax.random.fold_in(base_key, 0)
+        prefill_key = jax.random.fold_in(base_key, 1)
+
+        K = max(1, int(cfg.decode_steps_per_pass))
+
+        def _decode_sample(params, tokens, k_cache, v_cache, lengths,
+                           step, temps, top_ps, top_ks):
+            # K decode steps in one lax.scan: sampled tokens feed back
+            # into the next step on-device; rng derives in-graph from
+            # the step counter (no eager random.split per token)
+            def one(carry, k):
+                toks, kc, vc, lens = carry
+                key = jax.random.fold_in(decode_key, step * K + k)
+                logits, kc, vc = decode_fn(params, toks, kc, vc, lens)
+                nxt = _sample_batch(logits, key, temps, top_ps, top_ks)
+                return (nxt, kc, vc, lens + 1), nxt
+
+            (_, k_cache, v_cache, _), toks = jax.lax.scan(
+                one, (tokens, k_cache, v_cache, lengths), jnp.arange(K))
+            return toks, k_cache, v_cache  # [K, B]
+        self._decode = jax.jit(_decode_sample, donate_argnums=(2, 3))
+        self._decode_k = K
+        self._prefill_base_key = prefill_key
         self._prefill_cache: dict[int, Callable] = {}
         self._prefill_fn = prefill_fn
 
@@ -124,7 +161,7 @@ class Engine:
         from ..native.batch_queue import new_request_queue
         self.waiting = new_request_queue()
 
-        self._rng = jax.random.key(int(time.time() * 1e3) % (2**31))
+        self._rng_step = 0
         self._running = False
         self._thread: threading.Thread | None = None
         self._step_count = 0
@@ -215,9 +252,22 @@ class Engine:
         return self.config.prefill_buckets[-1]
 
     def _get_prefill(self, bucket: int) -> Callable:
+        """Fused prefill + first-token sample per bucket: returns
+        (token [1] int32, k, v) so the host pulls 4 bytes, not
+        [1, S, vocab] logits."""
         fn = self._prefill_cache.get(bucket)
         if fn is None:
-            fn = jax.jit(self._prefill_fn)
+            prefill_fn = self._prefill_fn
+
+            base_key = self._prefill_base_key
+
+            def fused(params, tokens, kv_len, step, temp, top_p, top_k):
+                key = jax.random.fold_in(base_key, step)
+                logits, (k, v) = prefill_fn(params, tokens, kv_len)
+                last = logits[0, kv_len[0] - 1]  # last prompt position
+                tok = _sample_batch(last[None], key, temp, top_p, top_k)
+                return tok, k, v
+            fn = jax.jit(fused)
             self._prefill_cache[bucket] = fn
         return fn
 
@@ -251,12 +301,17 @@ class Engine:
         tokens[0, :n] = req.prompt_tokens
         kv_len = jnp.array([n], jnp.int32)
         prefill = self._get_prefill(bucket)
-        logits, (k, v) = prefill(self.params, jnp.asarray(tokens), kv_len)
+        self._rng_step += 1
+        tok, k, v = prefill(
+            self.params, jnp.asarray(tokens), kv_len,
+            np.int32(self._rng_step),
+            jnp.asarray([req.params.temperature], jnp.float32),
+            jnp.asarray([req.params.top_p], jnp.float32),
+            jnp.asarray([req.params.top_k], jnp.int32))
         # write prompt kv into the slot (donated, in-place)
         self.k_cache, self.v_cache = self._insert(
             self.k_cache, self.v_cache, k, v, slot)
-        # first token from the last prompt position
-        first = self._sample_row(logits[0, n - 1], req)
+        first = int(tok[0])
         req.slot = slot
         req.first_token_at = time.time()
         req.generated.append(first)
@@ -270,15 +325,6 @@ class Engine:
                 req.first_token_at - req.submitted_at)
         if self._finished(req, first):
             self._retire(slot)
-
-    def _sample_row(self, logits_row: jnp.ndarray, req: GenRequest) -> int:
-        p = req.params
-        self._rng, key = jax.random.split(self._rng)
-        from ..ops.sampling import sample_tokens
-        token = sample_tokens(logits_row[None], key,
-                              temperature=p.temperature,
-                              top_k=p.top_k, top_p=p.top_p)
-        return int(token[0])
 
     def _finished(self, req: GenRequest, token: int) -> bool:
         if token == self.config.eos_id:
@@ -297,9 +343,18 @@ class Engine:
     # -------------------------------------------------------------- decode
     def _decode_step(self) -> None:
         cfg = self.config
+        K = self._decode_k
+        # a pass appends up to K rows per slot (last write at
+        # lengths+K-1 <= max_seq-1); slots without that headroom retire
+        # now, truncating at most K-1 tokens at the cache ceiling
+        for i, req in enumerate(self.active):
+            if req is not None and self.lengths[i] + K > cfg.max_seq:
+                self._retire(i)
+
         tokens = np.zeros(cfg.max_batch, np.int32)
         temps = np.zeros(cfg.max_batch, np.float32)
         top_ps = np.ones(cfg.max_batch, np.float32)
+        top_ks = np.zeros(cfg.max_batch, np.int32)
         active_mask = np.zeros(cfg.max_batch, bool)
         for i, req in enumerate(self.active):
             if req is None:
@@ -308,18 +363,18 @@ class Engine:
             tokens[i] = req.generated[-1]
             temps[i] = req.params.temperature
             top_ps[i] = req.params.top_p
+            top_ks[i] = req.params.top_k
         if not active_mask.any():
             return
 
         lengths = jnp.asarray(self.lengths)
-        self._rng, key = jax.random.split(self._rng)
+        self._rng_step += 1
         start = time.perf_counter()
-        logits, self.k_cache, self.v_cache = self._decode(
+        step_tokens, self.k_cache, self.v_cache = self._decode(
             self.params, jnp.asarray(tokens), self.k_cache, self.v_cache,
-            lengths)
-        next_tokens = _sample_batch(logits, key, jnp.asarray(temps),
-                                    jnp.asarray(top_ps))
-        next_np = np.asarray(next_tokens)
+            lengths, np.int32(self._rng_step), jnp.asarray(temps),
+            jnp.asarray(top_ps), jnp.asarray(top_ks))
+        step_np = np.asarray(step_tokens)  # [K, B]
         if self.metrics is not None:
             self.metrics.record_histogram(
                 "app_tpu_execute_seconds", time.perf_counter() - start)
@@ -328,12 +383,20 @@ class Engine:
         for i, req in enumerate(self.active):
             if req is None:
                 continue
-            token = int(next_np[i])
-            self.lengths[i] += 1
-            req.generated.append(token)
-            req._emit(token)
-            self.total_generated += 1
-            if self._finished(req, token) or self.lengths[i] >= cfg.max_seq - 1:
+            # the device appended K rows for this slot regardless of
+            # where the request stops; overshoot rows are dead weight
+            # masked out by kv_lengths after the next prefill
+            self.lengths[i] += K
+            done = False
+            for k in range(K):
+                token = int(step_np[k, i])
+                req.generated.append(token)
+                req._emit(token)
+                self.total_generated += 1
+                if self._finished(req, token):
+                    done = True
+                    break
+            if done:
                 self._retire(i)
 
     # ---------------------------------------------------------------- loop
@@ -356,9 +419,11 @@ class Engine:
 
 
 def _sample_batch(logits: jnp.ndarray, key: jax.Array,
-                  temperatures: jnp.ndarray, top_ps: jnp.ndarray) -> jnp.ndarray:
+                  temperatures: jnp.ndarray, top_ps: jnp.ndarray,
+                  top_ks: jnp.ndarray | None = None) -> jnp.ndarray:
     """Per-row sampling in one graph: greedy rows (temp==0) via argmax,
-    stochastic rows via top-p filtered gumbel draw."""
+    stochastic rows via top-k then top-p filtered gumbel draw
+    (``top_ks`` row value 0 disables top-k for that row)."""
     logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
@@ -366,6 +431,13 @@ def _sample_batch(logits: jnp.ndarray, key: jax.Array,
     scaled = logits / safe_t
 
     sorted_logits = jnp.sort(scaled, axis=-1)[..., ::-1]
+    if top_ks is not None:
+        vocab = scaled.shape[-1]
+        kth = jnp.clip(top_ks - 1, 0, vocab - 1).astype(jnp.int32)
+        k_threshold = jnp.take_along_axis(sorted_logits, kth[:, None],
+                                          axis=-1)
+        scaled = jnp.where((top_ks[:, None] > 0)
+                           & (scaled < k_threshold), NEG_INF, scaled)
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     keep_sorted = jnp.roll(cum, 1, axis=-1) < top_ps[:, None]

@@ -19,7 +19,7 @@ from .engine import Engine, SamplingParams
 
 def make_chat_handler(engine: Engine, tokenizer: Any):
     """POST /chat: {"prompt": str, "max_tokens"?, "temperature"?,
-    "top_p"?, "stream"?: bool}"""
+    "top_p"?, "top_k"?, "stream"?: bool}"""
 
     async def chat_handler(ctx):
         body = ctx.bind() or {}
@@ -33,10 +33,13 @@ def make_chat_handler(engine: Engine, tokenizer: Any):
             params = SamplingParams(
                 temperature=float(body.get("temperature", 0.7)),
                 top_p=float(body.get("top_p", 1.0)),
-                max_new_tokens=int(body.get("max_tokens", 128)),
+                top_k=int(body.get("top_k", 0)),
+                max_new_tokens=int(body.get("max_tokens",
+                                            body.get("max_new_tokens", 128))),
             )
         except (TypeError, ValueError) as exc:
-            raise ErrorInvalidParam("temperature/top_p/max_tokens") from exc
+            raise ErrorInvalidParam("temperature/top_p/top_k/max_tokens") \
+                from exc
         if params.max_new_tokens < 1 or params.max_new_tokens > 4096:
             raise ErrorInvalidParam("max_tokens")
 

@@ -117,3 +117,35 @@ def test_submit_from_thread_without_loop(engine):
     t.start()
     t.join(60)
     assert len(result["tokens"]) == 3
+
+
+def test_top_k_one_equals_greedy(engine):
+    """top_k=1 restricts sampling to the argmax even at temperature>0,
+    so it must reproduce the greedy continuation."""
+    prompt = list(range(1, 9))
+    greedy = engine.submit_sync(
+        prompt, SamplingParams(temperature=0.0, max_new_tokens=8))
+    k1 = engine.submit_sync(
+        prompt, SamplingParams(temperature=1.0, top_k=1, max_new_tokens=8))
+    assert k1.generated == greedy.generated
+
+
+def test_sample_batch_top_k_masks_rows():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from gofr_tpu.serving.engine import _sample_batch
+    logits = jnp.asarray([[0.0, 5.0, 4.0, 1.0],
+                          [0.0, 5.0, 4.0, 1.0]])
+    temps = jnp.asarray([1.0, 1.0], jnp.float32)
+    top_ps = jnp.asarray([1.0, 1.0], jnp.float32)
+    top_ks = jnp.asarray([1, 0], jnp.int32)  # row0 k=1, row1 unrestricted
+    seen0 = set()
+    seen1 = set()
+    for i in range(32):
+        out = np.asarray(_sample_batch(logits, jax.random.key(i),
+                                       temps, top_ps, top_ks))
+        seen0.add(int(out[0]))
+        seen1.add(int(out[1]))
+    assert seen0 == {1}          # k=1: always the argmax
+    assert len(seen1) > 1        # unrestricted row actually samples
