@@ -245,7 +245,11 @@ class App:
         engine.logger = self.logger
         self.container.add_model(name, engine)
         if self.container.tpu is None:
-            self.container.tpu = engine
+            from .device import DeviceRegistry
+            self.container.tpu = DeviceRegistry(
+                logger=self.logger, metrics=self.container.metrics)
+        if hasattr(self.container.tpu, "register_engine"):
+            self.container.tpu.register_engine(name, engine)
         if chat_path:
             from .serving.handlers import make_chat_handler
             from .serving.tokenizer import ByteTokenizer
@@ -339,6 +343,12 @@ class App:
 
         if self._cron is not None:
             self._tasks.append(asyncio.ensure_future(self._cron.run()))
+
+        # periodic TPU gauge refresh (device count, HBM in use)
+        if self.container.tpu is not None and \
+                hasattr(self.container.tpu, "metrics_loop"):
+            self._tasks.append(asyncio.ensure_future(
+                self.container.tpu.metrics_loop()))
 
         # remote log-level polling (reference container.go:107)
         from .logging.remote import from_config as remote_level_from_config
