@@ -75,7 +75,7 @@ BENCH_CODE = _PIN_PRELUDE + """
 import json, statistics, sys, time
 import jax.numpy as jnp
 
-from gofr_tpu.models.llama import LlamaConfig, llama_init
+from gofr_tpu.models.llama import LlamaConfig, llama_init, param_count
 from gofr_tpu.serving.engine import EngineConfig, SamplingParams
 from gofr_tpu.serving.glue import llama_engine
 
@@ -93,22 +93,26 @@ else:  # CI / CPU smoke: tiny everything
 t0 = time.time()
 params = llama_init(jax.random.key(0), model_config)
 jax.block_until_ready(params)
-print(f"# init {model_config.n_layers}L/{model_config.dim}d params in "
-      f"{time.time()-t0:.1f}s on {backend}", file=sys.stderr)
+n_params = param_count(params)
+print(f"# init {model_config.n_layers}L/{model_config.dim}d "
+      f"({n_params/1e9:.2f}B params) in {time.time()-t0:.1f}s on {backend}",
+      file=sys.stderr)
 
 engine = llama_engine(
     params, model_config,
     EngineConfig(max_batch=max_batch, max_seq=model_config.max_seq,
-                 prefill_buckets=(64, 128, 256, 512)))
-engine.start()
+                 prefill_buckets=(64, 128, 256, 512), seed=0))
 
 sp = SamplingParams(temperature=0.0, max_new_tokens=gen_len)
 prompt = list(range(1, prompt_len + 1))
 
-# warmup: compile prefill bucket + decode graph
+# warmup: compile every prefill group-size for the bucket + decode
 t0 = time.time()
-engine.submit_sync(prompt, sp)
+engine.warmup(prompt_lens=(prompt_len,))
 print(f"# warmup (compile) {time.time()-t0:.1f}s", file=sys.stderr)
+engine.start()
+engine.stats = {k: 0 if isinstance(v, int) else 0.0
+                for k, v in engine.stats.items()}
 
 # measured run: n_requests submitted up front (saturated server)
 t0 = time.time()
@@ -116,6 +120,7 @@ reqs = [engine.submit(prompt, sp) for _ in range(n_requests)]
 while any(r.finished_at is None and r.error is None for r in reqs):
     time.sleep(0.005)
 wall = time.time() - t0
+stats = dict(engine.stats)
 engine.stop()
 
 ok = [r for r in reqs if r.error is None]
@@ -125,8 +130,23 @@ tok_per_s = total_tokens / wall
 ttfts = sorted(r.ttft_ms for r in ok if r.ttft_ms is not None)
 p50_ttft = statistics.median(ttfts) if ttfts else -1.0
 
+# MFU: decode FLOPs ~= 2 * params per generated token (attention adds
+# ~2% at these lengths), prefill FLOPs = 2 * params * prompt tokens
+# (which already covers each request's first sampled token), against
+# the chip's peak bf16 FLOPs over the measured wall time.
+PEAK_FLOPS = {"TPU v5 lite": 197e12, "TPU v5": 459e12,
+              "TPU v5p": 459e12, "TPU v4": 275e12, "TPU v6 lite": 918e12}
+kind = jax.devices()[0].device_kind if on_accel else ""
+peak = next((v for k, v in sorted(PEAK_FLOPS.items(),
+                                  key=lambda kv: -len(kv[0]))
+             if kind.startswith(k)), None)
+flops = 2.0 * n_params * ((total_tokens - len(ok)) + len(ok) * prompt_len)
+mfu = round(flops / (wall * peak), 4) if peak else None
+host_s = round(wall - stats["prefill_s"] - stats["decode_s"], 2)
+
 print(f"# {len(ok)}/{n_requests} ok, wall={wall:.2f}s, "
-      f"decode={tok_per_s:.0f} tok/s, p50 TTFT={p50_ttft:.1f}ms",
+      f"decode={tok_per_s:.0f} tok/s, p50 TTFT={p50_ttft:.1f}ms, "
+      f"mfu={mfu}, phases={stats} host_s={host_s}",
       file=sys.stderr)
 
 print("BENCH_JSON " + json.dumps({
@@ -136,6 +156,12 @@ print("BENCH_JSON " + json.dumps({
     "vs_baseline": round(req_per_s / 2000.0, 4),
     "tok_per_s": round(tok_per_s, 1),
     "p50_ttft_ms": round(p50_ttft, 1),
+    "mfu": mfu,
+    "phases": {"prefill_s": round(stats["prefill_s"], 2),
+               "prefill_calls": stats["prefill_calls"],
+               "decode_s": round(stats["decode_s"], 2),
+               "decode_passes": stats["decode_passes"],
+               "host_s": host_s},
     "platform": backend,
     "n_requests": n_requests,
 }))

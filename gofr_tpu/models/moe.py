@@ -84,16 +84,17 @@ def _moe_mlp(x, lp, c: MoEConfig):
 
 
 def _logits(params, c, x):
+    # head in the weights' dtype with f32 accumulation (see
+    # models/llama.py::_logits for the rationale)
     x = rms_norm(x, params["final_norm"], c.norm_eps)
     head = params["embed"].T if c.tie_embeddings else params["lm_head"]
-    return x.astype(jnp.float32) @ head.astype(jnp.float32)
+    return jnp.matmul(x.astype(head.dtype), head,
+                      preferred_element_type=jnp.float32)
 
 
-def moe_prefill(params: dict, tokens: jnp.ndarray, config: MoEConfig, *,
-                kv_lengths: jnp.ndarray | None = None,
-                implementation: str = "auto"):
-    """tokens [B,S] -> (logits, (k_cache, v_cache), router_logits)."""
-    c = config
+def _moe_backbone(params, tokens, c: MoEConfig, kv_lengths, implementation):
+    """Embedding + all MoE blocks; final hidden [B, S, D] + caches +
+    per-layer router logits."""
     b, s = tokens.shape
     hd = c.head_dim
     inv_freq = rope_frequencies(hd, c.rope_theta, c.rope_scaling)
@@ -114,7 +115,28 @@ def moe_prefill(params: dict, tokens: jnp.ndarray, config: MoEConfig, *,
         return x + mlp_out, ((k, v), router_logits)
 
     x, ((ks, vs), router) = jax.lax.scan(layer_fn, x, params["layers"])
-    return _logits(params, c, x), (ks, vs), router
+    return x, (ks, vs), router
+
+
+def moe_prefill(params: dict, tokens: jnp.ndarray, config: MoEConfig, *,
+                kv_lengths: jnp.ndarray | None = None,
+                implementation: str = "auto"):
+    """tokens [B,S] -> (logits, (k_cache, v_cache), router_logits)."""
+    x, caches, router = _moe_backbone(params, tokens, config, kv_lengths,
+                                      implementation)
+    return _logits(params, config, x), caches, router
+
+
+def moe_prefill_last(params: dict, tokens: jnp.ndarray, config: MoEConfig, *,
+                     kv_lengths: jnp.ndarray, implementation: str = "auto"):
+    """Serving prefill: logits only at each row's last prompt position
+    (see models/llama.py::llama_prefill_last — the full [S, V] head is
+    pure waste for positions never sampled)."""
+    x, caches, router = _moe_backbone(params, tokens, config, kv_lengths,
+                                      implementation)
+    last = jnp.take_along_axis(
+        x, jnp.maximum(kv_lengths - 1, 0)[:, None, None], axis=1)[:, 0]
+    return _logits(params, config, last), caches, router
 
 
 def moe_decode_step(params: dict, tokens: jnp.ndarray,

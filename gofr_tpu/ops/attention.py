@@ -88,13 +88,17 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
     group = hq // hkv
     scale = scale if scale is not None else d ** -0.5
 
-    qf = q.astype(jnp.float32).reshape(b, sq, hkv, group, d)
-    kf = k_cache.astype(jnp.float32)
-    logits = jnp.einsum("bqhgd,bkhd->bhgqk", qf, kf) * scale  # [B,Hkv,G,Sq,Smax]
+    # einsums run in the cache dtype (bf16 in serving) with f32
+    # accumulation — no materialised f32 copy of the [B,Smax,Hkv,D]
+    # cache per layer; only the [.., Smax] logits/weights are f32.
+    qr = q.astype(k_cache.dtype).reshape(b, sq, hkv, group, d)
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", qr, k_cache,
+                        preferred_element_type=jnp.float32) * scale
     mask = jnp.arange(smax)[None, :] < kv_lengths[:, None]  # [B, Smax]
     logits = jnp.where(mask[:, None, None, None, :], logits, NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhgqk,bkhd->bqhgd", weights, v_cache.astype(jnp.float32))
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", weights.astype(v_cache.dtype),
+                     v_cache, preferred_element_type=jnp.float32)
     return out.reshape(b, sq, hq, d).astype(q.dtype)
 
 

@@ -16,6 +16,7 @@ graphs, not one per request — and exposes:
 
 from __future__ import annotations
 
+import asyncio
 import base64
 import time
 from dataclasses import dataclass
@@ -133,7 +134,6 @@ class ASRWorker:
     async def _drain(self, max_batch: int) -> list:
         """Block for the first message, then opportunistically grab more
         without waiting (continuous batching for the batch lane)."""
-        import asyncio
         first = await self.pubsub.subscribe(self.in_topic, self.group)
         messages = [first]
         while len(messages) < max_batch:
@@ -159,7 +159,11 @@ class ASRWorker:
                 msg.commit()  # poison message: drop, don't redeliver forever
         if not audios:
             return 0
-        results = self.transcriber.transcribe_batch(audios)
+        # the jitted batch is a long synchronous device call; run it in
+        # a worker thread so HTTP/health/pub-sub on this event loop
+        # stay live for the duration
+        results = await asyncio.to_thread(
+            self.transcriber.transcribe_batch, audios)
         for msg, result in zip(ok_msgs, results):
             request_id = ""
             payload = msg.bind()
@@ -173,7 +177,6 @@ class ASRWorker:
         return len(ok_msgs)
 
     async def run(self) -> None:
-        import asyncio
         while True:
             try:
                 await self.run_once()

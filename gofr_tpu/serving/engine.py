@@ -45,7 +45,11 @@ NEG_INF = -1e30
 class SamplingParams:
     temperature: float = 0.7
     top_p: float = 1.0
-    top_k: int = 0          # 0 = disabled
+    #: 0 disables the *explicit* top-k filter; stochastic sampling is
+    #: always bounded to the ``TOPK_BOUND`` (64) most likely tokens —
+    #: the engine's sampling graph never materialises the full-vocab
+    #: distribution (see ``_sample_batch``).
+    top_k: int = 0
     max_new_tokens: int = 128
 
 
@@ -65,7 +69,15 @@ class GenRequest:
 
     def _emit(self, token: int | None) -> None:
         if self.out_queue is not None and self.loop is not None:
-            self.loop.call_soon_threadsafe(self.out_queue.put_nowait, token)
+            try:
+                self.loop.call_soon_threadsafe(self.out_queue.put_nowait,
+                                               token)
+            except RuntimeError:
+                # the submitter's event loop died (client disconnect,
+                # worker reload): stop emitting to it — one dead client
+                # must never take down the engine hot loop
+                self.out_queue = None
+                self.loop = None
 
     @property
     def ttft_ms(self) -> float | None:
@@ -85,14 +97,26 @@ class EngineConfig:
     #: per-token host/dispatch overhead divides by K. Tokens stream in
     #: bursts of K and admission happens between passes, so large K
     #: trades TTFT/streaming granularity for throughput.
-    decode_steps_per_pass: int = 4
+    decode_steps_per_pass: int = 8
+    #: waiting requests prefilled per device call. The prefill graph is
+    #: a fixed [P, bucket] shape (short groups ride with masked dummy
+    #: rows, which cost nothing extra — the shapes are static either
+    #: way), so a burst of arrivals costs ceil(n/P) device round-trips
+    #: instead of n. Keep modest: P multiplies per-call prefill FLOPs.
+    prefill_batch: int = 8
+    #: sampling RNG seed; None draws entropy from ``os.urandom`` so two
+    #: engines started in the same millisecond never share streams. Set
+    #: for reproducible generation in tests/evals.
+    seed: int | None = None
 
 
 class Engine:
     """Continuous batching over a (prefill_fn, decode_fn) model pair.
 
-    prefill_fn(params, tokens[1, S], kv_lengths[1]) -> (logits[1, S, V],
-        (k [L,1,S,Hkv,hd], v)) — built from e.g. ``llama_prefill``.
+    prefill_fn(params, tokens[P, S], kv_lengths[P]) -> (logits,
+        (k [L,P,S,Hkv,hd], v)) where logits is [P, V] (last-position,
+        e.g. ``llama_prefill_last``) or [P, S, V] (full; the engine
+        gathers each row's last prompt position).
     decode_fn(params, tokens[B], k_cache, v_cache, lengths[B]) ->
         (logits[B, V], k_cache, v_cache) — e.g. ``llama_decode_step``.
     """
@@ -115,7 +139,10 @@ class Engine:
         # instead of the full [B, vocab] logits, and none of the
         # sampling math dispatches eagerly (each eager op is a host
         # round-trip, ruinous over a device tunnel)
-        base_key = jax.random.key(int(time.time() * 1e3) % (2**31))
+        import os as _os
+        seed = (cfg.seed if cfg.seed is not None
+                else int.from_bytes(_os.urandom(4), "little"))
+        base_key = jax.random.key(seed % (2**31))
         # disjoint rng streams: prefill and decode fold into separate
         # subkeys so their per-step indices can never collide
         decode_key = jax.random.fold_in(base_key, 0)
@@ -144,14 +171,13 @@ class Engine:
         self._prefill_cache: dict[int, Callable] = {}
         self._prefill_fn = prefill_fn
 
-        # cache insert donates the caches: an in-place HBM write, not a copy
-        def _insert(kc, vc, k, v, slot):
-            kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
-                                              (0, slot, 0, 0, 0))
-            vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
-                                              (0, slot, 0, 0, 0))
-            return kc, vc
-        self._insert = jax.jit(_insert, donate_argnums=(0, 1))
+        self._failed: str | None = None
+
+        # prefill buckets wider than the cache would scatter K/V slabs
+        # that cannot fit the [.., max_seq, ..] cache axis
+        self._usable_buckets = tuple(
+            b for b in cfg.prefill_buckets if b <= cfg.max_seq) \
+            or (cfg.max_seq,)
 
         self.k_cache, self.v_cache = make_cache(cfg.max_batch, cfg.max_seq)
         self.lengths = np.zeros(cfg.max_batch, np.int32)       # kv length per slot
@@ -163,9 +189,14 @@ class Engine:
 
         self._rng_step = 0
         self._running = False
+        self._cleaned = False
         self._thread: threading.Thread | None = None
         self._step_count = 0
         self.total_generated = 0
+        #: per-phase wall time (device call + sync) for perf accounting;
+        #: the bench surfaces these as the per-phase breakdown
+        self.stats = {"prefill_calls": 0, "prefill_s": 0.0,
+                      "decode_passes": 0, "decode_s": 0.0}
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> None:
@@ -179,28 +210,93 @@ class Engine:
     def stop(self) -> None:
         self._running = False
         if self._thread is not None:
+            # the engine thread runs _shutdown_cleanup itself when the
+            # loop exits, so a slow in-flight pass (e.g. a first-hit
+            # compile outliving the join timeout) can never race
+            # host-side cleanup: whoever finishes the loop retires the
+            # streams, exactly once
             self._thread.join(timeout=30)
+            if self._thread.is_alive():
+                # still mid device call (slow compile or wedged
+                # runtime): fail the *queued* requests now — the live
+                # thread only touches the queue via pop_batch, which
+                # returns None once closed — but leave active slots to
+                # the thread's own cleanup at pass end, so a stream
+                # can never see tokens after its terminal None. The
+                # thread handle stays set so repeated stop()/close()
+                # never run the full cleanup concurrently with it.
+                if self.logger:
+                    self.logger.warn(
+                        "engine thread still in a device call; streams "
+                        "retire when the pass completes")
+                self.waiting.close()
+                stranded = self.waiting.pop_batch(1 << 16, first_wait_s=0.0)
+                for req in stranded or []:
+                    self._fail(req, "engine stopped")
+                return
             self._thread = None
-        # terminal: refuse new submissions and fail anything stranded in
-        # the queue so no submitter waits on a request nothing will run
+        if not self._cleaned:  # loop never started (or crashed mid-start)
+            self._shutdown_cleanup("engine stopped")
+
+    def _shutdown_cleanup(self, reason: str) -> None:
+        """Terminal teardown: refuse new submissions, fail anything
+        stranded in the queue AND anything still holding a slot — no
+        submitter may be left waiting on a request nothing will run.
+        Runs on whichever thread finishes the loop, exactly once."""
+        self._cleaned = True
         self.waiting.close()
         stranded = self.waiting.pop_batch(1 << 16, first_wait_s=0.0)
         for req in stranded or []:
-            req.error = "engine stopped"
-            req.finished_at = time.time()
-            req._emit(None)
+            self._fail(req, reason)
+        for i, req in enumerate(self.active):
+            if req is not None:
+                self.active[i] = None
+                self.lengths[i] = 0
+                self._fail(req, reason)
 
     def health_check(self) -> dict:
-        return {
-            "status": "UP" if self._running else "DOWN",
+        status = "DOWN" if (self._failed or not self._running) else "UP"
+        out = {
+            "status": status,
             "active_slots": sum(r is not None for r in self.active),
             "waiting": self.waiting.qsize(),
             "steps": self._step_count,
             "total_generated": self.total_generated,
         }
+        if self._failed:
+            out["error"] = self._failed
+        return out
 
     def close(self) -> None:
         self.stop()
+
+    def warmup(self, prompt_lens: tuple = (1,), decode: bool = True) -> None:
+        """Compile serving graphs ahead of traffic: every power-of-two
+        prefill group size for each bucket covering ``prompt_lens``,
+        plus the decode pass. Dummy rows carry slot == max_batch so the
+        cache scatter drops them — real state is untouched. Call before
+        ``start()`` (it exercises the donated caches)."""
+        cfg = self.config
+        buckets = {self._bucket_for(int(n)) for n in prompt_lens}
+        for bucket in sorted(buckets):
+            for g in self._group_sizes():
+                fn = self._get_prefill(bucket, g)
+                toks, self.k_cache, self.v_cache = fn(
+                    self.params, jnp.zeros((g, bucket), jnp.int32),
+                    jnp.ones(g, jnp.int32), self.k_cache, self.v_cache,
+                    jnp.full(g, cfg.max_batch, jnp.int32), np.int32(0),
+                    jnp.zeros(g, jnp.float32), jnp.ones(g, jnp.float32),
+                    jnp.zeros(g, jnp.int32))
+                jax.block_until_ready(toks)
+        if decode:
+            toks, self.k_cache, self.v_cache = self._decode(
+                self.params, jnp.zeros(cfg.max_batch, jnp.int32),
+                self.k_cache, self.v_cache,
+                jnp.ones(cfg.max_batch, jnp.int32), np.int32(0),
+                jnp.zeros(cfg.max_batch, jnp.float32),
+                jnp.ones(cfg.max_batch, jnp.float32),
+                jnp.zeros(cfg.max_batch, jnp.int32))
+            jax.block_until_ready(toks)
 
     # -------------------------------------------------------------- submit
     def submit(self, prompt_tokens: list[int],
@@ -208,9 +304,12 @@ class Engine:
         """Called from the asyncio loop; returns a request whose
         ``out_queue`` yields token ids and then ``None``."""
         params = params or SamplingParams()
-        # keep the tail of over-long prompts, reserving room to generate
+        # keep the tail of over-long prompts, reserving room to generate;
+        # the largest usable prefill bucket is a hard cap — an admitted
+        # prompt must fit the widest prefill graph AND the cache
         room = max(1, min(params.max_new_tokens, self.config.max_seq // 2))
-        limit = max(1, self.config.max_seq - room - 1)
+        limit = max(1, min(self.config.max_seq - room - 1,
+                           max(self._usable_buckets)))
         if len(prompt_tokens) > limit:
             prompt_tokens = prompt_tokens[-limit:]
         req = GenRequest(prompt_tokens=list(prompt_tokens), params=params)
@@ -245,30 +344,54 @@ class Engine:
             yield token
 
     # ---------------------------------------------------------- scheduling
+    def _group_sizes(self) -> tuple:
+        """Compiled prefill group sizes: powers of two up to
+        ``prefill_batch``, plus ``prefill_batch`` itself when it is not
+        one — the admission chunk size always has an exact graph."""
+        cap = max(1, self.config.prefill_batch)
+        sizes = []
+        g = 1
+        while g < cap:
+            sizes.append(g)
+            g *= 2
+        sizes.append(cap)
+        return tuple(sizes)
+
     def _bucket_for(self, n: int) -> int:
-        for b in self.config.prefill_buckets:
+        for b in self._usable_buckets:
             if n <= b:
                 return b
-        return self.config.prefill_buckets[-1]
+        return self._usable_buckets[-1]
 
-    def _get_prefill(self, bucket: int) -> Callable:
-        """Fused prefill + first-token sample per bucket: returns
-        (token [1] int32, k, v) so the host pulls 4 bytes, not
-        [1, S, vocab] logits."""
-        fn = self._prefill_cache.get(bucket)
+    def _get_prefill(self, bucket: int, group: int) -> Callable:
+        """Fused group prefill per (bucket, group-size) — ONE device
+        call per group: forward [P, bucket], sample each row's first
+        token, and scatter the prompt K/V straight into the donated
+        caches (dummy rows carry slot == max_batch, dropped by the
+        scatter). The host pulls back 4·P bytes of token ids, nothing
+        else. Group sizes are powers of two up to ``prefill_batch`` so
+        a lone arrival runs a [1, bucket] graph, not the full-width
+        one, at the cost of ≤log2(P) extra compiles per bucket."""
+        fn = self._prefill_cache.get((bucket, group))
         if fn is None:
             prefill_fn = self._prefill_fn
-
             base_key = self._prefill_base_key
 
-            def fused(params, tokens, kv_len, step, temp, top_p, top_k):
+            def fused(params, tokens, kv_len, kc, vc, slots, step,
+                      temps, top_ps, top_ks):
                 key = jax.random.fold_in(base_key, step)
                 logits, (k, v) = prefill_fn(params, tokens, kv_len)
-                last = logits[0, kv_len[0] - 1]  # last prompt position
-                tok = _sample_batch(last[None], key, temp, top_p, top_k)
-                return tok, k, v
-            fn = jax.jit(fused)
-            self._prefill_cache[bucket] = fn
+                if logits.ndim == 3:  # full [P, S, V]: keep last position
+                    logits = jnp.take_along_axis(
+                        logits, jnp.maximum(kv_len - 1, 0)[:, None, None],
+                        axis=1)[:, 0]
+                toks = _sample_batch(logits, key, temps, top_ps, top_ks)
+                s = k.shape[2]
+                kc = kc.at[:, slots, :s].set(k.astype(kc.dtype), mode="drop")
+                vc = vc.at[:, slots, :s].set(v.astype(vc.dtype), mode="drop")
+                return toks, kc, vc
+            fn = jax.jit(fused, donate_argnums=(3, 4))
+            self._prefill_cache[(bucket, group)] = fn
         return fn
 
     def _free_slot(self) -> int:
@@ -277,54 +400,102 @@ class Engine:
                 return i
         return -1
 
-    def _admit(self, req: GenRequest) -> None:
-        slot = self._free_slot()
-        if slot < 0:  # raced; requeue for the next pass
-            if not self.waiting.put(req):
-                req.error = "engine not accepting requests"
-                req.finished_at = time.time()
-                req._emit(None)
+    def _fail(self, req: GenRequest, error: str) -> None:
+        req.error = error
+        req.finished_at = time.time()
+        req._emit(None)
+
+    def _admit_batch(self, reqs: list[GenRequest]) -> None:
+        """Admit a burst: group by prompt bucket, prefill each group in
+        chunks of ``prefill_batch`` with one device call per chunk."""
+        by_bucket: dict[int, list[GenRequest]] = {}
+        for req in reqs:
+            bucket = self._bucket_for(len(req.prompt_tokens))
+            by_bucket.setdefault(bucket, []).append(req)
+        P = max(1, self.config.prefill_batch)
+        for bucket, group in by_bucket.items():
+            for i in range(0, len(group), P):
+                self._prefill_group(bucket, group[i:i + P])
+
+    def _prefill_group(self, bucket: int, chunk: list[GenRequest]) -> None:
+        cfg = self.config
+        placed: list[GenRequest] = []
+        for req in chunk:
+            slot = self._free_slot()
+            if slot < 0:  # raced out of slots; back to the queue
+                if not self.waiting.put(req):
+                    self._fail(req, "engine not accepting requests")
+                continue
+            req.slot = slot
+            self.active[slot] = req       # reserve before the next scan
+            placed.append(req)
+        if not placed:
             return
+
+        # smallest compiled group size that fits: sparse traffic pays
+        # for a [1..2, bucket] forward, bursts amortise the full width
+        P = next(g for g in self._group_sizes() if g >= len(placed))
+        self._rng_step += 1
+        start = time.perf_counter()
         try:
-            self._prefill_into_slot(req, slot)
+            tokens = np.zeros((P, bucket), np.int32)
+            kv_len = np.ones(P, np.int32)                # dummy rows: length 1
+            slots = np.full(P, cfg.max_batch, np.int32)  # dummy rows: dropped
+            temps = np.zeros(P, np.float32)
+            top_ps = np.ones(P, np.float32)
+            top_ks = np.zeros(P, np.int32)
+            for row, req in enumerate(placed):
+                n = len(req.prompt_tokens)
+                tokens[row, :n] = req.prompt_tokens
+                kv_len[row] = n
+                slots[row] = req.slot
+                temps[row] = req.params.temperature
+                top_ps[row] = req.params.top_p
+                top_ks[row] = req.params.top_k
+
+            prefill = self._get_prefill(bucket, P)
+            toks, self.k_cache, self.v_cache = prefill(
+                self.params, jnp.asarray(tokens), jnp.asarray(kv_len),
+                self.k_cache, self.v_cache, jnp.asarray(slots),
+                np.int32(self._rng_step), jnp.asarray(temps),
+                jnp.asarray(top_ps), jnp.asarray(top_ks))
+            toks_np = np.asarray(toks)
+            self.stats["prefill_calls"] += 1
+            self.stats["prefill_s"] += time.perf_counter() - start
         except Exception as exc:
-            req.error = str(exc)
-            req.finished_at = time.time()
-            req._emit(None)
+            for req in placed:
+                self.active[req.slot] = None
+                self._fail(req, str(exc))
             if self.logger:
                 self.logger.error(f"prefill failed: {exc!r}")
+            # the failed call may have consumed the donated caches; if
+            # so, every active slot's KV went with them — fail those
+            # streams honestly and stand up fresh caches so the engine
+            # keeps serving new requests
+            if self.k_cache.is_deleted() or self.v_cache.is_deleted():
+                for i, req in enumerate(self.active):
+                    if req is not None:
+                        self.active[i] = None
+                        self._fail(req, f"kv cache lost to failed prefill: "
+                                        f"{exc}")
+                self.lengths[:] = 0
+                self.k_cache, self.v_cache = self._make_cache(
+                    cfg.max_batch, cfg.max_seq)
+            return
 
-    def _prefill_into_slot(self, req: GenRequest, slot: int) -> None:
-        n = len(req.prompt_tokens)
-        bucket = self._bucket_for(n)
-        tokens = np.full((1, bucket), 0, np.int32)
-        tokens[0, :n] = req.prompt_tokens
-        kv_len = jnp.array([n], jnp.int32)
-        prefill = self._get_prefill(bucket)
-        self._rng_step += 1
-        tok, k, v = prefill(
-            self.params, jnp.asarray(tokens), kv_len,
-            np.int32(self._rng_step),
-            jnp.asarray([req.params.temperature], jnp.float32),
-            jnp.asarray([req.params.top_p], jnp.float32),
-            jnp.asarray([req.params.top_k], jnp.int32))
-        # write prompt kv into the slot (donated, in-place)
-        self.k_cache, self.v_cache = self._insert(
-            self.k_cache, self.v_cache, k, v, slot)
-        first = int(tok[0])
-        req.slot = slot
-        req.first_token_at = time.time()
-        req.generated.append(first)
-        req._emit(first)
-        self.total_generated += 1
-        self.lengths[slot] = n
-        self.active[slot] = req
-        if self.metrics is not None:
-            self.metrics.record_histogram(
-                "app_chat_ttft_seconds",
-                req.first_token_at - req.submitted_at)
-        if self._finished(req, first):
-            self._retire(slot)
+        now = time.time()
+        for row, req in enumerate(placed):
+            first = int(toks_np[row])
+            req.first_token_at = now
+            req.generated.append(first)
+            req._emit(first)
+            self.total_generated += 1
+            self.lengths[req.slot] = len(req.prompt_tokens)
+            if self.metrics is not None:
+                self.metrics.record_histogram(
+                    "app_chat_ttft_seconds", now - req.submitted_at)
+            if self._finished(req, first):
+                self._retire(req.slot)
 
     def _finished(self, req: GenRequest, token: int) -> bool:
         if token == self.config.eos_id:
@@ -344,11 +515,12 @@ class Engine:
     def _decode_step(self) -> None:
         cfg = self.config
         K = self._decode_k
-        # a pass appends up to K rows per slot (last write at
-        # lengths+K-1 <= max_seq-1); slots without that headroom retire
-        # now, truncating at most K-1 tokens at the cache ceiling
+        # slots with no headroom at all retire before the pass; slots
+        # with 1..K-1 rows of headroom run the pass and keep exactly
+        # the tokens whose cache writes landed (see valid below) — the
+        # cache ceiling truncates nothing anymore
         for i, req in enumerate(self.active):
-            if req is not None and self.lengths[i] + K > cfg.max_seq:
+            if req is not None and self.lengths[i] >= cfg.max_seq:
                 self._retire(i)
 
         tokens = np.zeros(cfg.max_batch, np.int32)
@@ -375,6 +547,8 @@ class Engine:
             lengths, np.int32(self._rng_step), jnp.asarray(temps),
             jnp.asarray(top_ps), jnp.asarray(top_ks))
         step_np = np.asarray(step_tokens)  # [K, B]
+        self.stats["decode_passes"] += 1
+        self.stats["decode_s"] += time.perf_counter() - start
         if self.metrics is not None:
             self.metrics.record_histogram(
                 "app_tpu_execute_seconds", time.perf_counter() - start)
@@ -383,12 +557,14 @@ class Engine:
         for i, req in enumerate(self.active):
             if req is None:
                 continue
-            # the device appended K rows for this slot regardless of
-            # where the request stops; overshoot rows are dead weight
-            # masked out by kv_lengths after the next prefill
-            self.lengths[i] += K
+            # steps whose cache write would land past max_seq-1 were
+            # dropped by the device scatter and attended to stale rows;
+            # their sampled tokens are garbage — keep only the valid
+            # prefix and retire the slot at the ceiling
+            valid = min(K, cfg.max_seq - int(self.lengths[i]))
+            self.lengths[i] += valid
             done = False
-            for k in range(K):
+            for k in range(valid):
                 token = int(step_np[k, i])
                 req.generated.append(token)
                 req._emit(token)
@@ -396,57 +572,86 @@ class Engine:
                 if self._finished(req, token):
                     done = True
                     break
-            if done:
+            if done or valid < K:
                 self._retire(i)
 
     # ---------------------------------------------------------------- loop
     def _loop(self) -> None:
-        while self._running:
-            free = sum(1 for r in self.active if r is None)
-            busy = free < self.config.max_batch
-            if free > 0:
-                # one batched pop per pass (TTFT priority): blocks while
-                # fully idle — in the native queue the engine thread
-                # sleeps in C with the GIL released — and is a zero-wait
-                # drain between decode steps while busy
-                batch = self.waiting.pop_batch(
-                    free, first_wait_s=0.0 if busy else 0.05,
-                    drain_wait_s=0.0)
-                for req in batch or []:
-                    self._admit(req)
-            if any(r is not None for r in self.active):
-                self._decode_step()
+        try:
+            while self._running:
+                free = sum(1 for r in self.active if r is None)
+                busy = free < self.config.max_batch
+                if free > 0:
+                    # one batched pop per pass (TTFT priority): blocks
+                    # while fully idle — in the native queue the engine
+                    # thread sleeps in C with the GIL released — and is
+                    # a zero-wait drain between decode steps while busy
+                    batch = self.waiting.pop_batch(
+                        free, first_wait_s=0.0 if busy else 0.05,
+                        drain_wait_s=0.0)
+                    if batch:
+                        self._admit_batch(batch)
+                if any(r is not None for r in self.active):
+                    self._decode_step()
+        except Exception as exc:  # containment: never die silently
+            self._crash(exc)
+        else:
+            self._shutdown_cleanup("engine stopped")
+
+    def _crash(self, exc: BaseException) -> None:
+        """The hot loop threw: fail every in-flight request, refuse new
+        ones, and flip health DOWN so orchestrators can see it.
+
+        The reference refuses to let one request take the process down
+        (panic recovery, /root/reference/pkg/gofr/handler.go:141); for
+        an engine thread the equivalent blast-radius control is failing
+        fast and loudly rather than hanging every stream forever."""
+        self._failed = f"{type(exc).__name__}: {exc}"
+        self._running = False
+        if self.logger:
+            self.logger.error(f"engine loop crashed: {exc!r}")
+        self._shutdown_cleanup(f"engine crashed: {self._failed}")
+
+
+#: static cap on the candidate set per row. ``lax.top_k`` over this many
+#: columns replaces a full-vocab bitonic sort (128k wide on Llama-3 —
+#: measured as the single largest cost in the fused decode graph). Any
+#: realistic top-k/top-p nucleus fits in 64 candidates; rows whose
+#: nucleus would be wider are truncated to the 64 most likely tokens.
+TOPK_BOUND = 64
 
 
 def _sample_batch(logits: jnp.ndarray, key: jax.Array,
                   temperatures: jnp.ndarray, top_ps: jnp.ndarray,
                   top_ks: jnp.ndarray | None = None) -> jnp.ndarray:
-    """Per-row sampling in one graph: greedy rows (temp==0) via argmax,
-    stochastic rows via top-k then top-p filtered gumbel draw
-    (``top_ks`` row value 0 disables top-k for that row)."""
+    """Per-row sampling in one graph: greedy rows (temp==0) take the
+    top-1 candidate; stochastic rows gumbel-sample within the
+    ``TOPK_BOUND`` most likely tokens after the row's top-k filter and
+    a top-p filter applied *on the top-k-renormalised* distribution
+    (``top_ks`` row value 0 disables top-k for that row).
+    """
     logits = logits.astype(jnp.float32)
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    bound = min(TOPK_BOUND, logits.shape[-1])
 
     safe_t = jnp.maximum(temperatures, 1e-6)[:, None]
-    scaled = logits / safe_t
+    vals, idx = jax.lax.top_k(logits / safe_t, bound)  # sorted descending
 
-    sorted_logits = jnp.sort(scaled, axis=-1)[..., ::-1]
+    # top-k first: mask candidates beyond each row's k (0 = disabled)
+    pos = jnp.arange(bound)[None, :]
     if top_ks is not None:
-        vocab = scaled.shape[-1]
-        kth = jnp.clip(top_ks - 1, 0, vocab - 1).astype(jnp.int32)
-        k_threshold = jnp.take_along_axis(sorted_logits, kth[:, None],
-                                          axis=-1)
-        scaled = jnp.where((top_ks[:, None] > 0)
-                           & (scaled < k_threshold), NEG_INF, scaled)
-    probs = jax.nn.softmax(sorted_logits, axis=-1)
+        k_eff = jnp.where(top_ks > 0, jnp.minimum(top_ks, bound), bound)
+        vals = jnp.where(pos < k_eff[:, None], vals, NEG_INF)
+
+    # then top-p on the renormalised survivor distribution
+    probs = jax.nn.softmax(vals, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
-    keep_sorted = jnp.roll(cum, 1, axis=-1) < top_ps[:, None]
-    keep_sorted = keep_sorted.at[..., 0].set(True)
-    kept = jnp.where(keep_sorted, sorted_logits, jnp.inf)
-    threshold = jnp.min(kept, axis=-1, keepdims=True)
-    filtered = jnp.where(scaled < threshold, NEG_INF, scaled)
+    keep = jnp.roll(cum, 1, axis=-1) < top_ps[:, None]
+    keep = keep.at[..., 0].set(True)
+    filtered = jnp.where(keep, vals, NEG_INF)
 
     gumbel = -jnp.log(-jnp.log(
-        jax.random.uniform(key, scaled.shape, minval=1e-20, maxval=1.0) + 1e-20))
-    sampled = jnp.argmax(filtered + gumbel, axis=-1).astype(jnp.int32)
-    return jnp.where(temperatures <= 0.0, greedy, sampled)
+        jax.random.uniform(key, vals.shape, minval=1e-20, maxval=1.0) + 1e-20))
+    choice = jnp.argmax(filtered + gumbel, axis=-1)
+    sampled = jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0]
+    # temperature scaling is monotonic, so idx[:, 0] IS the argmax
+    return jnp.where(temperatures <= 0.0, idx[:, 0], sampled).astype(jnp.int32)

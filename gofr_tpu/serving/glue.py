@@ -8,7 +8,7 @@ from ..models.llama import (
     LlamaConfig,
     llama_decode_step,
     llama_init,
-    llama_prefill,
+    llama_prefill_last,
     make_empty_cache,
 )
 from .engine import Engine, EngineConfig
@@ -22,8 +22,11 @@ def llama_engine(params: Any, model_config: LlamaConfig,
     c = model_config
 
     def prefill_fn(params, tokens, kv_lengths):
-        return llama_prefill(params, tokens, c, kv_lengths=kv_lengths,
-                             implementation=implementation)
+        # last-position logits only: a serving prefill never needs the
+        # [S, vocab] head matmul (larger than the whole backbone at
+        # short S) for positions it won't sample from
+        return llama_prefill_last(params, tokens, c, kv_lengths=kv_lengths,
+                                  implementation=implementation)
 
     def decode_fn(params, tokens, k_cache, v_cache, lengths):
         return llama_decode_step(params, tokens, k_cache, v_cache, lengths, c)
@@ -39,13 +42,13 @@ def llama_engine(params: Any, model_config: LlamaConfig,
 def moe_engine(params: Any, model_config, engine_config: EngineConfig | None = None,
                *, metrics: Any = None, logger: Any = None,
                implementation: str = "auto") -> Engine:
-    from ..models.moe import moe_decode_step, moe_prefill
+    from ..models.moe import moe_decode_step, moe_prefill_last
     import jax.numpy as jnp
     engine_config = engine_config or EngineConfig()
     c = model_config
 
     def prefill_fn(params, tokens, kv_lengths):
-        logits, caches, _router = moe_prefill(
+        logits, caches, _router = moe_prefill_last(
             params, tokens, c, kv_lengths=kv_lengths,
             implementation=implementation)
         return logits, caches

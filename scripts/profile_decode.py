@@ -1,0 +1,148 @@
+"""Per-phase profiling of the serving decode path on the real chip.
+
+Times each component of the engine hot loop separately so perf work is
+aimed at measured cost, not guesses:
+  - prefill (bucket 64, batch 1)  [current engine shape]
+  - decode pass (K steps fused, batch 16)
+  - sampling alone (full-vocab sort vs lax.top_k path)
+  - LM head alone (f32 vs bf16)
+  - decode_attention alone (f32 upcast vs bf16)
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gofr_tpu.models.llama import (LlamaConfig, llama_decode_step, llama_init,
+                                   llama_prefill, make_empty_cache)
+from gofr_tpu.serving.engine import _sample_batch
+
+B, S, PROMPT = 16, 1024, 64
+c = LlamaConfig.llama3_1b().scaled(max_seq=S)
+params = llama_init(jax.random.key(0), c)
+jax.block_until_ready(params)
+print(f"backend={jax.default_backend()}", file=sys.stderr)
+
+
+def bench(label, fn, *args, n=20, **kw):
+    t0 = time.perf_counter()
+    out = fn(*args, **kw)       # compile
+    jax.block_until_ready(out)
+    print(f"# compiled {label} in {time.perf_counter()-t0:.1f}s",
+          file=sys.stderr, flush=True)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{label:46s} {dt*1e3:9.2f} ms", flush=True)
+    return dt
+
+
+# ---- prefill, current engine shape (batch 1, bucket 64)
+tokens1 = jnp.ones((1, PROMPT), jnp.int32)
+kvlen1 = jnp.array([PROMPT], jnp.int32)
+pf = jax.jit(lambda p, t, l: llama_prefill(p, t, c, kv_lengths=l))
+bench("prefill b=1 s=64 (full logits out)", pf, params, tokens1, kvlen1, n=5)
+
+# prefill returning only last-position logits (what engine needs)
+pf_last = jax.jit(
+    lambda p, t, l: (llama_prefill(p, t, c, kv_lengths=l)[0][0, l[0] - 1],))
+bench("prefill b=1 s=64 (last logits only)", pf_last, params, tokens1, kvlen1, n=5)
+
+# batched prefill
+tokens8 = jnp.ones((8, PROMPT), jnp.int32)
+kvlen8 = jnp.full((8,), PROMPT, jnp.int32)
+pf8 = jax.jit(lambda p, t, l: llama_prefill(p, t, c, kv_lengths=l))
+bench("prefill b=8 s=64 (full logits out)", pf8, params, tokens8, kvlen8, n=5)
+
+# ---- decode step
+kc, vc = make_empty_cache(c, B, S)
+lengths = jnp.full((B,), PROMPT, jnp.int32)
+toks = jnp.ones((B,), jnp.int32)
+dec = jax.jit(lambda p, t, k, v, l: llama_decode_step(p, t, k, v, l, c))
+out = dec(params, toks, kc, vc, lengths)
+jax.block_until_ready(out)
+logits, kc, vc = out
+t0 = time.perf_counter()
+N = 20
+for _ in range(N):
+    logits, kc, vc = dec(params, toks, kc, vc, lengths)
+jax.block_until_ready(logits)
+dt = (time.perf_counter() - t0) / N
+print(f"{'decode step b=16 (logits out, no sample)':46s} {dt*1e3:9.2f} ms")
+
+# ---- sampling alone on [B, V] logits
+key = jax.random.key(1)
+temps = jnp.full((B,), 0.7, jnp.float32)
+top_ps = jnp.full((B,), 0.9, jnp.float32)
+top_ks = jnp.full((B,), 40, jnp.int32)
+samp_sort = jax.jit(lambda lg, k: _sample_batch(lg, k, temps, top_ps, top_ks))
+bench("sample full-vocab sort [16,128256]", samp_sort, logits, key)
+
+
+def sample_topk(logits, key):
+    logits = logits.astype(jnp.float32)
+    vals, idx = jax.lax.top_k(logits, 64)
+    scaled = vals / jnp.maximum(temps, 1e-6)[:, None]
+    kth = jnp.clip(top_ks - 1, 0, 63)
+    thr = jnp.take_along_axis(scaled, kth[:, None], axis=-1)
+    scaled = jnp.where((top_ks[:, None] > 0) & (scaled < thr), -1e30, scaled)
+    probs = jax.nn.softmax(scaled, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = jnp.roll(cum, 1, axis=-1) < top_ps[:, None]
+    keep = keep.at[..., 0].set(True)
+    filt = jnp.where(keep, scaled, -1e30)
+    g = -jnp.log(-jnp.log(jax.random.uniform(key, filt.shape, minval=1e-20)))
+    choice = jnp.argmax(filt + g, axis=-1)
+    samp = jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0]
+    greedy = idx[:, 0]
+    return jnp.where(temps <= 0.0, greedy, samp).astype(jnp.int32)
+
+
+bench("sample lax.top_k(64) path", jax.jit(sample_topk), logits, key)
+
+# ---- LM head alone
+x = jnp.ones((B, c.dim), jnp.bfloat16)
+head = params["embed"]
+f32head = jax.jit(lambda x, h: x.astype(jnp.float32) @ h.T.astype(jnp.float32))
+bench("lm head f32 x f32 [16,2048]x[2048,128256]", f32head, x, head)
+bf16head = jax.jit(lambda x, h: jnp.einsum(
+    "bd,vd->bv", x, h, preferred_element_type=jnp.float32))
+bench("lm head bf16 (f32 accum)", bf16head, x, head)
+
+# ---- decode attention alone (one layer's worth, cache slice)
+from gofr_tpu.ops.attention import decode_attention
+q = jnp.ones((B, 1, c.n_heads, c.head_dim), jnp.bfloat16)
+kc1 = jnp.ones((B, S, c.n_kv_heads, c.head_dim), jnp.bfloat16)
+vc1 = jnp.ones((B, S, c.n_kv_heads, c.head_dim), jnp.bfloat16)
+bench("decode_attention 1 layer (f32 upcast)",
+      jax.jit(lambda q, k, v: decode_attention(q, k, v, lengths)), q, kc1, vc1)
+
+
+def decode_attn_bf16(q, k_cache, v_cache, kv_lengths):
+    b, sq, hq, d = q.shape
+    _, smax, hkv, _ = k_cache.shape
+    group = hq // hkv
+    scale = d ** -0.5
+    qr = q.reshape(b, sq, hkv, group, d)
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", qr, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    mask = jnp.arange(smax)[None, :] < kv_lengths[:, None]
+    logits = jnp.where(mask[:, None, None, None, :], logits, -1e30)
+    w = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", w.astype(jnp.bfloat16), v_cache,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, sq, hq, d).astype(q.dtype)
+
+
+bench("decode_attention 1 layer (bf16 einsum)",
+      jax.jit(lambda q, k, v: decode_attn_bf16(q, k, v, lengths)), q, kc1, vc1)
+
+# ---- dispatch overhead: trivial jitted fn round-trip
+triv = jax.jit(lambda x: x + 1)
+bench("trivial dispatch round-trip", triv, jnp.zeros((16,), jnp.int32), n=50)
