@@ -1,0 +1,77 @@
+"""Paged KV cache primitives — block-table indirection over a page pool.
+
+The serving engine's paged layout (vLLM-style, re-designed for XLA's
+static-shape world): K/V live in a pool ``[L, n_pages, page, Hkv, hd]``
+and each slot owns an ordered list of page ids (its *block table*,
+shape ``[max_pages]``). Capacity is decoupled from ``max_batch x
+max_seq``: slots allocate pages as they grow and free them on retire,
+so many long-tailed requests overcommit a pool that a contiguous
+per-slot layout could never fit.
+
+Everything here is a pure jittable function on static shapes:
+
+- :func:`gather_view` materialises a slot-contiguous ``[L, B, S, ...]``
+  view once per K-step decode pass (NOT per token) — the engine then
+  runs the model family's ordinary dense decode step on the view, so
+  paged mode needs zero model changes.
+- :func:`scatter_prefill` / :func:`scatter_decode` write prompt slabs /
+  freshly decoded rows back through the table. Unallocated positions
+  map to the out-of-range page id (``n_pages``), which XLA's scatter
+  drops — padding rows and dummy slots cost nothing and corrupt
+  nothing.
+
+Free-list bookkeeping is host-side (``serving/engine.py``): the device
+never sees an allocator, only tables.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def gather_view(pool: jnp.ndarray, tables: jnp.ndarray) -> jnp.ndarray:
+    """Pool [L, Np, pg, H, d] + tables [B, Mp] -> view [L, B, Mp*pg, H, d].
+
+    Out-of-range table entries (unallocated = Np) clamp to the last
+    page on gather; those rows are masked by the caller's kv_lengths.
+    """
+    l, np_, pg, h, d = pool.shape
+    b, mp = tables.shape
+    view = pool[:, tables]                      # [L, B, Mp, pg, H, d]
+    return view.reshape(l, b, mp * pg, h, d)
+
+
+def scatter_prefill(pool: jnp.ndarray, tables: jnp.ndarray,
+                    k_slab: jnp.ndarray) -> jnp.ndarray:
+    """Write a prompt K (or V) slab [L, P, S, H, d] into the pool via
+    per-row tables [P, Mp]. Positions whose table entry is the OOB page
+    id are dropped (padding beyond each row's allocation, dummy rows).
+    """
+    pg = pool.shape[2]
+    s = k_slab.shape[2]
+    pos = jnp.arange(s)
+    pids = jnp.take(tables, pos // pg, axis=1)          # [P, S]
+    offs = jnp.broadcast_to(pos % pg, pids.shape)       # [P, S]
+    return pool.at[:, pids, offs].set(k_slab, mode="drop")
+
+
+def scatter_decode(pool: jnp.ndarray, tables: jnp.ndarray,
+                   view: jnp.ndarray, lengths: jnp.ndarray,
+                   k_steps: int) -> jnp.ndarray:
+    """Copy the ``k_steps`` rows a decode pass appended to ``view``
+    (at logical positions lengths .. lengths+K-1 per slot) back into
+    the pool. view [L, B, S, H, d], tables [B, Mp], lengths [B].
+    """
+    pg = pool.shape[2]
+    n_pages = pool.shape[1]
+    s = view.shape[2]
+    positions = lengths[:, None] + jnp.arange(k_steps)[None, :]   # [B, K]
+    clamped = jnp.minimum(positions, s - 1)
+    new_rows = jnp.take_along_axis(
+        view, clamped[None, :, :, None, None], axis=2)  # [L, B, K, H, d]
+    pids = jnp.take_along_axis(tables, clamped // pg, axis=1)     # [B, K]
+    # positions past the logical view (a slot at the cache ceiling
+    # taking a partial pass) must drop, not overwrite the last row
+    pids = jnp.where(positions < s, pids, n_pages)
+    offs = clamped % pg
+    return pool.at[:, pids, offs].set(new_rows, mode="drop")

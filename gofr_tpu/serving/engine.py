@@ -22,8 +22,11 @@ Architecture (one device or one mesh):
   (prefill-priority keeps TTFT low; decode continues for everyone else
   next step).
 
-This is the slot-based v1 cache (contiguous per-slot rows); the paged
-allocator can replace it behind the same interface.
+Two KV layouts share the loop (``EngineConfig.kv_layout``): "slot"
+keeps contiguous per-slot rows; "paged" adds block-table indirection
+over a page pool (``ops/paged_kv.py``) with allocation on admission,
+frees on retire, and vLLM-style preemption-by-recompute when the pool
+runs dry — KV capacity decoupled from ``max_batch x max_seq``.
 """
 
 from __future__ import annotations
@@ -66,6 +69,10 @@ class GenRequest:
     out_queue: Any = None          # asyncio.Queue[int | None]
     loop: Any = None               # the submitting event loop
     error: str | None = None
+    admit_order: int = -1          # paged preemption picks the newest;
+                                   # assigned once at first admission and
+                                   # kept across preemption-requeues so a
+                                   # re-admitted old request stays old
 
     def _emit(self, token: int | None) -> None:
         if self.out_queue is not None and self.loop is not None:
@@ -108,6 +115,18 @@ class EngineConfig:
     #: engines started in the same millisecond never share streams. Set
     #: for reproducible generation in tests/evals.
     seed: int | None = None
+    #: "slot" = contiguous per-slot rows (max_batch x max_seq, simplest
+    #: and fastest per step); "paged" = block-table indirection over a
+    #: page pool (ops/paged_kv.py) — capacity decoupled from
+    #: max_batch x max_seq, pages allocated on admission and freed on
+    #: retire, preemption-by-recompute when the pool runs dry.
+    kv_layout: str = "slot"
+    #: rows per KV page (paged layout only)
+    page_size: int = 64
+    #: pool size in pages; None sizes the pool to the full contiguous
+    #: capacity (max_batch x ceil(max_seq/page_size)). Smaller values
+    #: overcommit: more concurrent short requests in the same HBM.
+    kv_pages: int | None = None
 
 
 class Engine:
@@ -133,6 +152,9 @@ class Engine:
         self._make_cache = make_cache
 
         cfg = config
+        if cfg.kv_layout not in ("slot", "paged"):
+            raise ValueError(f"kv_layout must be 'slot' or 'paged', "
+                             f"got {cfg.kv_layout!r}")
 
         # decode + sampling fused into ONE graph returning just the
         # sampled token ids [B] — the per-step host transfer is 4B/slot
@@ -150,8 +172,8 @@ class Engine:
 
         K = max(1, int(cfg.decode_steps_per_pass))
 
-        def _decode_sample(params, tokens, k_cache, v_cache, lengths,
-                           step, temps, top_ps, top_ks):
+        def _scan_decode(params, tokens, k_view, v_view, lengths,
+                         step, temps, top_ps, top_ks):
             # K decode steps in one lax.scan: sampled tokens feed back
             # into the next step on-device; rng derives in-graph from
             # the step counter (no eager random.split per token)
@@ -162,10 +184,36 @@ class Engine:
                 nxt = _sample_batch(logits, key, temps, top_ps, top_ks)
                 return (nxt, kc, vc, lens + 1), nxt
 
-            (_, k_cache, v_cache, _), toks = jax.lax.scan(
-                one, (tokens, k_cache, v_cache, lengths), jnp.arange(K))
-            return toks, k_cache, v_cache  # [K, B]
-        self._decode = jax.jit(_decode_sample, donate_argnums=(2, 3))
+            return jax.lax.scan(
+                one, (tokens, k_view, v_view, lengths), jnp.arange(K))
+
+        if cfg.kv_layout == "paged":
+            from ..ops.paged_kv import (gather_view, scatter_decode,
+                                        scatter_prefill)
+            self._scatter_prefill = scatter_prefill
+
+            def _decode_sample(params, tokens, k_pool, v_pool, tables,
+                               lengths, step, temps, top_ps, top_ks):
+                # ONE gather per K-step pass builds the slot-contiguous
+                # view the dense decode step runs on; only the K fresh
+                # rows scatter back — the model family never sees pages
+                k_view = gather_view(k_pool, tables)
+                v_view = gather_view(v_pool, tables)
+                (_, k_view, v_view, _), toks = _scan_decode(
+                    params, tokens, k_view, v_view, lengths,
+                    step, temps, top_ps, top_ks)
+                k_pool = scatter_decode(k_pool, tables, k_view, lengths, K)
+                v_pool = scatter_decode(v_pool, tables, v_view, lengths, K)
+                return toks, k_pool, v_pool  # [K, B]
+            self._decode = jax.jit(_decode_sample, donate_argnums=(2, 3))
+        else:
+            def _decode_sample(params, tokens, k_cache, v_cache, lengths,
+                               step, temps, top_ps, top_ks):
+                (_, k_cache, v_cache, _), toks = _scan_decode(
+                    params, tokens, k_cache, v_cache, lengths,
+                    step, temps, top_ps, top_ks)
+                return toks, k_cache, v_cache  # [K, B]
+            self._decode = jax.jit(_decode_sample, donate_argnums=(2, 3))
         self._decode_k = K
         self._prefill_base_key = prefill_key
         self._prefill_cache: dict[int, Callable] = {}
@@ -179,7 +227,23 @@ class Engine:
             b for b in cfg.prefill_buckets if b <= cfg.max_seq) \
             or (cfg.max_seq,)
 
-        self.k_cache, self.v_cache = make_cache(cfg.max_batch, cfg.max_seq)
+        if cfg.kv_layout == "paged":
+            pg = max(1, int(cfg.page_size))
+            self._pages_per_slot = -(-cfg.max_seq // pg)        # ceil
+            self._n_pages = (cfg.kv_pages if cfg.kv_pages is not None
+                             else cfg.max_batch * self._pages_per_slot)
+            # make_cache(batch, seq) -> [L, batch, seq, Hkv, hd]; calling
+            # it as (n_pages, page) yields exactly the pool layout
+            self.k_cache, self.v_cache = make_cache(self._n_pages, pg)
+            self._free_pages = list(range(self._n_pages))
+            #: per-slot ordered page ids; OOB id ``n_pages`` = unallocated
+            self._tables = np.full((cfg.max_batch, self._pages_per_slot),
+                                   self._n_pages, np.int32)
+            self._slot_pages = np.zeros(cfg.max_batch, np.int32)
+            self._admit_seq = 0
+        else:
+            self.k_cache, self.v_cache = make_cache(cfg.max_batch,
+                                                    cfg.max_seq)
         self.lengths = np.zeros(cfg.max_batch, np.int32)       # kv length per slot
         self.active: list[GenRequest | None] = [None] * cfg.max_batch
         # admission queue: C++ waitable batch queue when a toolchain
@@ -277,26 +341,45 @@ class Engine:
         cache scatter drops them — real state is untouched. Call before
         ``start()`` (it exercises the donated caches)."""
         cfg = self.config
+        paged = cfg.kv_layout == "paged"
         buckets = {self._bucket_for(int(n)) for n in prompt_lens}
         for bucket in sorted(buckets):
             for g in self._group_sizes():
+                if paged:  # all-OOB tables: every write drops
+                    slots = jnp.full((g, self._pages_per_slot),
+                                     self._n_pages, jnp.int32)
+                else:
+                    slots = jnp.full(g, cfg.max_batch, jnp.int32)
                 fn = self._get_prefill(bucket, g)
                 toks, self.k_cache, self.v_cache = fn(
                     self.params, jnp.zeros((g, bucket), jnp.int32),
                     jnp.ones(g, jnp.int32), self.k_cache, self.v_cache,
-                    jnp.full(g, cfg.max_batch, jnp.int32), np.int32(0),
+                    slots, np.int32(0),
                     jnp.zeros(g, jnp.float32), jnp.ones(g, jnp.float32),
                     jnp.zeros(g, jnp.int32))
                 jax.block_until_ready(toks)
         if decode:
+            b = cfg.max_batch
+            tables = (jnp.full((b, self._pages_per_slot), self._n_pages,
+                               jnp.int32),) if paged else ()
             toks, self.k_cache, self.v_cache = self._decode(
-                self.params, jnp.zeros(cfg.max_batch, jnp.int32),
-                self.k_cache, self.v_cache,
-                jnp.ones(cfg.max_batch, jnp.int32), np.int32(0),
-                jnp.zeros(cfg.max_batch, jnp.float32),
-                jnp.ones(cfg.max_batch, jnp.float32),
-                jnp.zeros(cfg.max_batch, jnp.int32))
+                self.params, jnp.zeros(b, jnp.int32),
+                self.k_cache, self.v_cache, *tables,
+                jnp.ones(b, jnp.int32), np.int32(0),
+                jnp.zeros(b, jnp.float32), jnp.ones(b, jnp.float32),
+                jnp.zeros(b, jnp.int32))
             jax.block_until_ready(toks)
+
+    def _clamp_prompt(self, tokens: list[int], max_new: int) -> list[int]:
+        """Keep the tail of an over-long prompt, reserving room to
+        generate; the largest usable prefill bucket is a hard cap — an
+        admitted prompt must fit the widest prefill graph AND the
+        cache. (Preemption-requeue clamps less aggressively: see
+        ``_preempt`` — its continuation already fit the cache.)"""
+        room = max(1, min(max_new, self.config.max_seq // 2))
+        limit = max(1, min(self.config.max_seq - room - 1,
+                           max(self._usable_buckets)))
+        return tokens[-limit:] if len(tokens) > limit else tokens
 
     # -------------------------------------------------------------- submit
     def submit(self, prompt_tokens: list[int],
@@ -304,15 +387,9 @@ class Engine:
         """Called from the asyncio loop; returns a request whose
         ``out_queue`` yields token ids and then ``None``."""
         params = params or SamplingParams()
-        # keep the tail of over-long prompts, reserving room to generate;
-        # the largest usable prefill bucket is a hard cap — an admitted
-        # prompt must fit the widest prefill graph AND the cache
-        room = max(1, min(params.max_new_tokens, self.config.max_seq // 2))
-        limit = max(1, min(self.config.max_seq - room - 1,
-                           max(self._usable_buckets)))
-        if len(prompt_tokens) > limit:
-            prompt_tokens = prompt_tokens[-limit:]
-        req = GenRequest(prompt_tokens=list(prompt_tokens), params=params)
+        prompt_tokens = self._clamp_prompt(list(prompt_tokens),
+                                           params.max_new_tokens)
+        req = GenRequest(prompt_tokens=prompt_tokens, params=params)
         try:
             req.loop = asyncio.get_running_loop()
             req.out_queue = asyncio.Queue()
@@ -320,9 +397,7 @@ class Engine:
             req.loop = None
             req.out_queue = None
         if not self.waiting.put(req):  # full/closed: fail loudly, never hang
-            req.error = "engine not accepting requests"
-            req.finished_at = time.time()
-            req._emit(None)
+            self._fail(req, "engine not accepting requests")
         return req
 
     def submit_sync(self, prompt_tokens: list[int],
@@ -377,6 +452,9 @@ class Engine:
             prefill_fn = self._prefill_fn
             base_key = self._prefill_base_key
 
+            paged = self.config.kv_layout == "paged"
+            scatter_prefill = getattr(self, "_scatter_prefill", None)
+
             def fused(params, tokens, kv_len, kc, vc, slots, step,
                       temps, top_ps, top_ks):
                 key = jax.random.fold_in(base_key, step)
@@ -386,9 +464,16 @@ class Engine:
                         logits, jnp.maximum(kv_len - 1, 0)[:, None, None],
                         axis=1)[:, 0]
                 toks = _sample_batch(logits, key, temps, top_ps, top_ks)
-                s = k.shape[2]
-                kc = kc.at[:, slots, :s].set(k.astype(kc.dtype), mode="drop")
-                vc = vc.at[:, slots, :s].set(v.astype(vc.dtype), mode="drop")
+                if paged:
+                    # ``slots`` carries each row's block table [P, Mp]
+                    kc = scatter_prefill(kc, slots, k.astype(kc.dtype))
+                    vc = scatter_prefill(vc, slots, v.astype(vc.dtype))
+                else:
+                    s = k.shape[2]
+                    kc = kc.at[:, slots, :s].set(k.astype(kc.dtype),
+                                                 mode="drop")
+                    vc = vc.at[:, slots, :s].set(v.astype(vc.dtype),
+                                                 mode="drop")
                 return toks, kc, vc
             fn = jax.jit(fused, donate_argnums=(3, 4))
             self._prefill_cache[(bucket, group)] = fn
@@ -399,6 +484,72 @@ class Engine:
             if r is None:
                 return i
         return -1
+
+    # ------------------------------------------------------ paged alloc
+    def _alloc_pages(self, slot: int, rows: int) -> bool:
+        """Grow ``slot``'s block table to cover ``rows`` logical rows;
+        False when the free list cannot (caller preempts or defers)."""
+        pg = self.config.page_size
+        need = min(-(-rows // pg), self._pages_per_slot)
+        have = int(self._slot_pages[slot])
+        if need <= have:
+            return True
+        if need - have > len(self._free_pages):
+            return False
+        for i in range(have, need):
+            self._tables[slot, i] = self._free_pages.pop()
+        self._slot_pages[slot] = need
+        return True
+
+    def _release_pages(self, slot: int) -> None:
+        n = int(self._slot_pages[slot])
+        for i in range(n):
+            self._free_pages.append(int(self._tables[slot, i]))
+        self._tables[slot, :] = self._n_pages
+        self._slot_pages[slot] = 0
+
+    def _preempt(self, slot: int) -> None:
+        """Evict a request, keeping its stream open: pages return to
+        the pool now, the request re-enters the queue with prompt =
+        original prompt + everything generated, and the next prefill
+        recomputes its KV and samples its next token — vLLM-style
+        preemption-by-recompute, which on TPU costs one extra bucketed
+        prefill instead of a cache swap to host memory."""
+        req = self.active[slot]
+        if req is None:
+            return
+        self.active[slot] = None
+        self.lengths[slot] = 0
+        self._release_pages(slot)
+        # the continuation IS the cache content at eviction (<= max_seq
+        # rows by construction): re-prefilling it reproduces the exact
+        # token positions, so greedy outputs cannot diverge. Only the
+        # widest prefill bucket truncates (divergence then unavoidable
+        # without chunked prefill — requires buckets narrower than
+        # max_seq, non-default).
+        req.prompt_tokens = list(req.prompt_tokens) + list(req.generated)
+        limit = min(max(self._usable_buckets), self.config.max_seq)
+        if len(req.prompt_tokens) > limit:
+            req.prompt_tokens = req.prompt_tokens[-limit:]
+        if not self.waiting.put(req):
+            self._fail(req, "engine not accepting requests")
+
+    def _ensure_headroom(self, slot: int, rows: int) -> bool:
+        """Allocate pages for ``rows`` logical rows, preempting the
+        newest *younger* active request as needed — an older request
+        (closer to completion) is never evicted for a newer one. False
+        when no younger victim remains and the pool still cannot cover
+        this slot (the caller preempts ``slot`` itself)."""
+        mine = self.active[slot].admit_order
+        while not self._alloc_pages(slot, rows):
+            victims = [i for i, r in enumerate(self.active)
+                       if r is not None and i != slot
+                       and r.admit_order > mine]
+            if not victims:
+                return False
+            self._preempt(max(
+                victims, key=lambda i: self.active[i].admit_order))
+        return True
 
     def _fail(self, req: GenRequest, error: str) -> None:
         req.error = error
@@ -419,6 +570,7 @@ class Engine:
 
     def _prefill_group(self, bucket: int, chunk: list[GenRequest]) -> None:
         cfg = self.config
+        paged = cfg.kv_layout == "paged"
         placed: list[GenRequest] = []
         for req in chunk:
             slot = self._free_slot()
@@ -426,6 +578,21 @@ class Engine:
                 if not self.waiting.put(req):
                     self._fail(req, "engine not accepting requests")
                 continue
+            if paged:
+                pg = cfg.page_size
+                if -(-(len(req.prompt_tokens) + 1) // pg) > self._n_pages:
+                    # can never fit, no matter what retires
+                    self._fail(req, "prompt exceeds kv pool")
+                    continue
+                if not self._alloc_pages(slot, len(req.prompt_tokens) + 1):
+                    # pool busy: requeue and wait for retires to free
+                    # pages
+                    if not self.waiting.put(req):
+                        self._fail(req, "engine not accepting requests")
+                    continue
+                if req.admit_order < 0:
+                    req.admit_order = self._admit_seq
+                    self._admit_seq += 1
             req.slot = slot
             self.active[slot] = req       # reserve before the next scan
             placed.append(req)
@@ -440,7 +607,11 @@ class Engine:
         try:
             tokens = np.zeros((P, bucket), np.int32)
             kv_len = np.ones(P, np.int32)                # dummy rows: length 1
-            slots = np.full(P, cfg.max_batch, np.int32)  # dummy rows: dropped
+            if paged:  # per-row block tables; dummy rows all-OOB: dropped
+                slots = np.full((P, self._pages_per_slot), self._n_pages,
+                                np.int32)
+            else:      # slot ids; dummy rows OOB: dropped
+                slots = np.full(P, cfg.max_batch, np.int32)
             temps = np.zeros(P, np.float32)
             top_ps = np.ones(P, np.float32)
             top_ks = np.zeros(P, np.int32)
@@ -448,7 +619,7 @@ class Engine:
                 n = len(req.prompt_tokens)
                 tokens[row, :n] = req.prompt_tokens
                 kv_len[row] = n
-                slots[row] = req.slot
+                slots[row] = self._tables[req.slot] if paged else req.slot
                 temps[row] = req.params.temperature
                 top_ps[row] = req.params.top_p
                 top_ks[row] = req.params.top_k
@@ -465,6 +636,8 @@ class Engine:
         except Exception as exc:
             for req in placed:
                 self.active[req.slot] = None
+                if paged:
+                    self._release_pages(req.slot)
                 self._fail(req, str(exc))
             if self.logger:
                 self.logger.error(f"prefill failed: {exc!r}")
@@ -479,21 +652,29 @@ class Engine:
                         self._fail(req, f"kv cache lost to failed prefill: "
                                         f"{exc}")
                 self.lengths[:] = 0
-                self.k_cache, self.v_cache = self._make_cache(
-                    cfg.max_batch, cfg.max_seq)
+                if paged:  # same pool geometry + a pristine allocator
+                    self.k_cache, self.v_cache = self._make_cache(
+                        self._n_pages, cfg.page_size)
+                    self._free_pages = list(range(self._n_pages))
+                    self._tables[:] = self._n_pages
+                    self._slot_pages[:] = 0
+                else:
+                    self.k_cache, self.v_cache = self._make_cache(
+                        cfg.max_batch, cfg.max_seq)
             return
 
         now = time.time()
         for row, req in enumerate(placed):
             first = int(toks_np[row])
-            req.first_token_at = now
+            if req.first_token_at is None:  # not a preemption recompute
+                req.first_token_at = now
+                if self.metrics is not None:
+                    self.metrics.record_histogram(
+                        "app_chat_ttft_seconds", now - req.submitted_at)
             req.generated.append(first)
             req._emit(first)
             self.total_generated += 1
             self.lengths[req.slot] = len(req.prompt_tokens)
-            if self.metrics is not None:
-                self.metrics.record_histogram(
-                    "app_chat_ttft_seconds", now - req.submitted_at)
             if self._finished(req, first):
                 self._retire(req.slot)
 
@@ -510,11 +691,14 @@ class Engine:
         req._emit(None)
         self.active[slot] = None
         self.lengths[slot] = 0
+        if self.config.kv_layout == "paged":
+            self._release_pages(slot)
 
     # -------------------------------------------------------------- decode
     def _decode_step(self) -> None:
         cfg = self.config
         K = self._decode_k
+        paged = cfg.kv_layout == "paged"
         # slots with no headroom at all retire before the pass; slots
         # with 1..K-1 rows of headroom run the pass and keep exactly
         # the tokens whose cache writes landed (see valid below) — the
@@ -522,6 +706,20 @@ class Engine:
         for i, req in enumerate(self.active):
             if req is not None and self.lengths[i] >= cfg.max_seq:
                 self._retire(i)
+        if paged:
+            # grow each slot's block table to cover this pass, evicting
+            # the newest requests when the pool runs dry (they resume
+            # by recompute); iterate oldest-first so survivors are the
+            # requests closest to completion
+            order = sorted(
+                (i for i, r in enumerate(self.active) if r is not None),
+                key=lambda i: self.active[i].admit_order)
+            for i in order:
+                if self.active[i] is None:  # preempted by an earlier slot
+                    continue
+                rows = min(int(self.lengths[i]) + K, cfg.max_seq)
+                if not self._ensure_headroom(i, rows):
+                    self._preempt(i)  # pool can't hold even this one now
 
         tokens = np.zeros(cfg.max_batch, np.int32)
         temps = np.zeros(cfg.max_batch, np.float32)
@@ -542,9 +740,10 @@ class Engine:
         lengths = jnp.asarray(self.lengths)
         self._rng_step += 1
         start = time.perf_counter()
+        tables = (jnp.asarray(self._tables),) if paged else ()
         step_tokens, self.k_cache, self.v_cache = self._decode(
             self.params, jnp.asarray(tokens), self.k_cache, self.v_cache,
-            lengths, np.int32(self._rng_step), jnp.asarray(temps),
+            *tables, lengths, np.int32(self._rng_step), jnp.asarray(temps),
             jnp.asarray(top_ps), jnp.asarray(top_ks))
         step_np = np.asarray(step_tokens)  # [K, B]
         self.stats["decode_passes"] += 1

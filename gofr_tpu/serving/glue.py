@@ -1,4 +1,15 @@
-"""Model -> Engine glue: build engines from model families."""
+"""Model -> Engine glue: build engines from model families.
+
+Single-device and mesh-sharded serving share one engine: pass
+``mesh=`` to shard the model Megatron-style (``parallel/sharding.py``
+specs) and the KV cache over the mesh's ``tp`` axis on the kv-head
+dim. The decode step stays ONE donated jitted call — XLA inserts the
+all-gathers/reduce-scatters over ICI; nothing in the engine hot loop
+changes. This is the serving analog of the reference's horizontal
+scale-out behind its service client (reference
+pkg/gofr/service/new.go:68); on TPU the "replicas" are mesh shards in
+a single SPMD program, coordinated by the runtime rather than HTTP.
+"""
 
 from __future__ import annotations
 
@@ -14,25 +25,61 @@ from ..models.llama import (
 from .engine import Engine, EngineConfig
 
 
+def _kv_sharding(mesh: Any):
+    """NamedSharding for [L, B, S, Hkv, hd] caches / prompt-KV slabs:
+    kv heads over ``tp``, everything else replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    tp = "tp" if "tp" in mesh.axis_names else None
+    return NamedSharding(mesh, P(None, None, None, tp, None))
+
+
 def llama_engine(params: Any, model_config: LlamaConfig,
                  engine_config: EngineConfig | None = None, *,
+                 mesh: Any = None,
                  metrics: Any = None, logger: Any = None,
                  implementation: str = "auto") -> Engine:
     engine_config = engine_config or EngineConfig()
     c = model_config
 
+    constrain_kv = None
+    if mesh is not None:
+        import jax
+        import jax.numpy as jnp
+        from ..parallel.sharding import llama_param_specs, shard_params
+        params = shard_params(params, mesh, llama_param_specs(mesh))
+        kv_sharding = _kv_sharding(mesh)
+
+        def constrain_kv(t):
+            # pin cache outputs to the input sharding so the donated
+            # buffers round-trip in place across passes
+            return jax.lax.with_sharding_constraint(t, kv_sharding)
+
     def prefill_fn(params, tokens, kv_lengths):
         # last-position logits only: a serving prefill never needs the
         # [S, vocab] head matmul (larger than the whole backbone at
         # short S) for positions it won't sample from
-        return llama_prefill_last(params, tokens, c, kv_lengths=kv_lengths,
-                                  implementation=implementation)
+        logits, (k, v) = llama_prefill_last(
+            params, tokens, c, kv_lengths=kv_lengths,
+            implementation=implementation)
+        if constrain_kv is not None:
+            k, v = constrain_kv(k), constrain_kv(v)
+        return logits, (k, v)
 
     def decode_fn(params, tokens, k_cache, v_cache, lengths):
-        return llama_decode_step(params, tokens, k_cache, v_cache, lengths, c)
+        logits, kc, vc = llama_decode_step(params, tokens, k_cache,
+                                           v_cache, lengths, c)
+        if constrain_kv is not None:
+            kc, vc = constrain_kv(kc), constrain_kv(vc)
+        return logits, kc, vc
 
     def make_cache(batch, max_seq):
-        return make_empty_cache(c, batch, max_seq=max_seq)
+        kc, vc = make_empty_cache(c, batch, max_seq=max_seq)
+        if mesh is not None:
+            import jax
+            sharding = _kv_sharding(mesh)
+            kc = jax.device_put(kc, sharding)
+            vc = jax.device_put(vc, sharding)
+        return kc, vc
 
     return Engine(params, engine_config, prefill_fn=prefill_fn,
                   decode_fn=decode_fn, make_cache=make_cache,

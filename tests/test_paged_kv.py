@@ -1,0 +1,164 @@
+"""Paged KV primitives + the engine's paged mode."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.ops.paged_kv import gather_view, scatter_decode, scatter_prefill
+
+L, NP, PG, H, D = 2, 6, 4, 2, 3   # layers, pages, page size, heads, head dim
+
+
+def _pool(fill=0.0):
+    return jnp.full((L, NP, PG, H, D), fill, jnp.float32)
+
+
+def test_scatter_prefill_then_gather_roundtrip():
+    pool = _pool()
+    # one row owning pages [2, 0], prompt length 6 (spans both pages)
+    tables = jnp.asarray([[2, 0, NP]], jnp.int32)           # Mp = 3
+    slab = jnp.arange(L * 1 * 8 * H * D, dtype=jnp.float32).reshape(
+        L, 1, 8, H, D)                                       # S = 8 > 6: padded
+    pool = scatter_prefill(pool, tables, slab)
+    view = gather_view(pool, tables)
+    np.testing.assert_array_equal(np.asarray(view[:, :, :8]),
+                                  np.asarray(slab))
+
+
+def test_scatter_prefill_drops_unallocated_padding():
+    pool = _pool(-1.0)
+    tables = jnp.asarray([[1, NP, NP]], jnp.int32)          # only page 1
+    slab = jnp.ones((L, 1, 8, H, D), jnp.float32)           # rows 4..7 OOB
+    pool = scatter_prefill(pool, tables, slab)
+    got = np.asarray(pool)
+    assert (got[:, 1] == 1.0).all()                         # page 1 written
+    mask = np.ones(NP, bool)
+    mask[1] = False
+    assert (got[:, mask] == -1.0).all()                     # others untouched
+
+
+def test_scatter_prefill_dummy_row_dropped():
+    pool = _pool(-1.0)
+    tables = jnp.asarray([[NP, NP, NP]], jnp.int32)         # dummy row
+    slab = jnp.ones((L, 1, 4, H, D), jnp.float32)
+    pool = scatter_prefill(pool, tables, slab)
+    assert (np.asarray(pool) == -1.0).all()
+
+
+def test_scatter_decode_writes_k_rows():
+    pool = _pool()
+    tables = jnp.asarray([[3, 1, NP]], jnp.int32)
+    view = jnp.zeros((L, 1, 12, H, D), jnp.float32)
+    # pass appended K=2 rows at logical positions 3, 4 (page boundary!)
+    view = view.at[:, 0, 3].set(7.0)
+    view = view.at[:, 0, 4].set(8.0)
+    pool = scatter_decode(pool, tables, view, jnp.asarray([3]), 2)
+    got = np.asarray(pool)
+    assert (got[:, 3, 3] == 7.0).all()   # logical 3 -> page 3, offset 3
+    assert (got[:, 1, 0] == 8.0).all()   # logical 4 -> page 1, offset 0
+    assert got.sum() == (7.0 + 8.0) * L * H * D
+
+
+def test_scatter_decode_past_view_end_drops():
+    pool = _pool(-1.0)
+    tables = jnp.asarray([[0, 1, 2]], jnp.int32)
+    view = jnp.zeros((L, 1, 12, H, D), jnp.float32)
+    pool = scatter_decode(pool, tables, view, jnp.asarray([11]), 2)
+    got = np.asarray(pool)
+    # position 11 lands (page 2, offset 3); position 12 is dropped
+    assert (got[:, 2, 3] == 0.0).all()
+    untouched = np.full_like(got, -1.0)
+    untouched[:, 2, 3] = 0.0
+    np.testing.assert_array_equal(got, untouched)
+
+
+# ---------------------------------------------------------------- engine
+
+from gofr_tpu.serving.engine import EngineConfig, SamplingParams  # noqa: E402
+from gofr_tpu.serving.glue import demo_llama_engine  # noqa: E402
+
+
+def _drain(reqs, timeout=120):
+    deadline = time.time() + timeout
+    while time.time() < deadline and any(
+            r.finished_at is None and r.error is None for r in reqs):
+        time.sleep(0.01)
+    return reqs
+
+
+def test_paged_engine_matches_slot_engine():
+    cfg = dict(max_batch=4, max_seq=128, seed=17)
+    slot = demo_llama_engine(EngineConfig(**cfg))
+    slot.start()
+    want = [slot.submit([3 + i, 1, 4], SamplingParams(
+        temperature=0.0, max_new_tokens=10)) for i in range(4)]
+    _drain(want)
+    slot.stop()
+
+    paged = demo_llama_engine(EngineConfig(kv_layout="paged", page_size=16,
+                                           **cfg))
+    paged.start()
+    got = [paged.submit([3 + i, 1, 4], SamplingParams(
+        temperature=0.0, max_new_tokens=10)) for i in range(4)]
+    _drain(got)
+    paged.stop()
+
+    assert [r.generated for r in got] == [r.generated for r in want]
+    assert all(r.error is None for r in got)
+
+
+def test_paged_overcommit_beyond_contiguous_capacity():
+    """Total logical capacity (max_batch * max_seq = 4*128 rows) does
+    not fit the pool (12 pages * 16 = 192 rows), but short requests do:
+    the engine must serve more concurrent requests than the contiguous
+    layout could hold in the same memory."""
+    eng = demo_llama_engine(EngineConfig(
+        max_batch=4, max_seq=128, seed=2,
+        kv_layout="paged", page_size=16, kv_pages=12))
+    eng.start()
+    reqs = [eng.submit([1 + i, 2, 3], SamplingParams(
+        temperature=0.0, max_new_tokens=8)) for i in range(8)]
+    _drain(reqs)
+    eng.stop()
+    assert all(r.error is None for r in reqs), [r.error for r in reqs]
+    assert all(len(r.generated) == 8 for r in reqs)
+
+
+def test_paged_preemption_recomputes_and_completes():
+    """Pool too small for all admitted requests to run to their full
+    length: the engine preempts (freeing pages, recomputing later) and
+    every request still finishes with exactly its token budget."""
+    eng = demo_llama_engine(EngineConfig(
+        max_batch=4, max_seq=128, seed=8,
+        kv_layout="paged", page_size=16, kv_pages=8))  # 128 rows total
+    eng.start()
+    reqs = [eng.submit(list(range(1, 30)), SamplingParams(
+        temperature=0.0, max_new_tokens=24)) for _ in range(4)]
+    _drain(reqs)
+    eng.stop()
+    assert all(r.error is None for r in reqs), [r.error for r in reqs]
+    assert all(len(r.generated) == 24 for r in reqs)
+
+
+def test_paged_greedy_unaffected_by_preemption():
+    """Preemption-by-recompute must not change greedy outputs."""
+    roomy = demo_llama_engine(EngineConfig(
+        max_batch=2, max_seq=128, seed=4, kv_layout="paged", page_size=16))
+    roomy.start()
+    want = roomy.submit_sync(list(range(1, 20)), SamplingParams(
+        temperature=0.0, max_new_tokens=16)).generated
+    roomy.stop()
+
+    tight = demo_llama_engine(EngineConfig(
+        max_batch=2, max_seq=128, seed=4,
+        kv_layout="paged", page_size=16, kv_pages=5))
+    tight.start()
+    got = [tight.submit(list(range(1, 20)), SamplingParams(
+        temperature=0.0, max_new_tokens=16)) for _ in range(2)]
+    _drain(got)
+    tight.stop()
+    assert all(r.error is None for r in got)
+    assert all(r.generated == want for r in got)
