@@ -319,9 +319,25 @@ class App:
             raise RuntimeError("on_start hook failed")
 
         handler = self._build_http_handler()
+        # CERT_FILE + KEY_FILE switch the main listener to TLS
+        # (reference pkg/gofr/http_server.go:74-86); the metrics port
+        # stays plaintext for scrapers, as in the reference.
+        ssl_context = None
+        cert_file = self.config.get("CERT_FILE")
+        key_file = self.config.get("KEY_FILE")
+        if cert_file and key_file:
+            from .http.server import make_ssl_context
+            try:
+                ssl_context = make_ssl_context(cert_file, key_file)
+            except (OSError, ValueError) as exc:
+                # never degrade to cleartext on a port clients expect
+                # to be HTTPS — fail startup, as ListenAndServeTLS does
+                self.logger.error(f"TLS config invalid: {exc}")
+                raise RuntimeError(
+                    f"invalid CERT_FILE/KEY_FILE: {exc}") from exc
         self.http_server = HTTPServer(
             handler, host="0.0.0.0", port=self.http_port,
-            logger=self.logger)
+            logger=self.logger, ssl_context=ssl_context)
         await self.http_server.start()
         self._servers.append(self.http_server)
 

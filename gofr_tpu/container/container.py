@@ -86,6 +86,21 @@ class Container:
             exporter = ConsoleExporter(logger)
         elif exporter_kind == "memory":
             exporter = InMemoryExporter()
+        elif exporter_kind in ("otlp", "jaeger", "zipkin"):
+            # network exporters to a real collector by URL (reference
+            # otel.go:131-151; jaeger accepts both protocols — use OTLP)
+            url = config.get_or_default(
+                "TRACER_URL", config.get_or_default(
+                    "TRACER_HOST", "localhost"))
+            if "://" not in url:
+                port = config.get_or_default(
+                    "TRACER_PORT", "9411" if exporter_kind == "zipkin"
+                    else "4318")
+                url = f"http://{url}:{port}"
+            from ..tracing.export import OTLPHTTPExporter, ZipkinExporter
+            cls = ZipkinExporter if exporter_kind == "zipkin" \
+                else OTLPHTTPExporter
+            exporter = cls(url, service_name=c.app_name, logger=logger)
         c.tracer = Tracer(service_name=c.app_name, exporter=exporter, ratio=ratio)
 
         # Env-driven datasources (reference container.go:128-174); anything
@@ -96,9 +111,20 @@ class Container:
         c.redis = new_redis(config, logger, c.metrics, c.tracer)
 
         # pub/sub backend switch (reference container.go:132-172 selects
-        # KAFKA/GOOGLE/MQTT from PUBSUB_BACKEND; ours: NATS/MQTT/MEMORY)
+        # KAFKA/GOOGLE/MQTT from PUBSUB_BACKEND; ours:
+        # KAFKA/NATS/MQTT/MEMORY)
         backend = config.get_or_default("PUBSUB_BACKEND", "").upper()
-        if backend == "NATS":
+        if backend == "KAFKA":
+            from ..pubsub.kafka import KafkaClient
+            c.add_pubsub(KafkaClient(
+                brokers=config.get_or_default("PUBSUB_BROKER",
+                                              "127.0.0.1:9092"),
+                group_id=config.get_or_default("KAFKA_CONSUMER_GROUP",
+                                               c.app_name),
+                client_id=c.app_name,
+                auto_offset=config.get_or_default(
+                    "KAFKA_AUTO_OFFSET", "earliest").lower()))
+        elif backend == "NATS":
             from ..pubsub.nats import NATSClient
             addr = config.get_or_default("PUBSUB_BROKER", "127.0.0.1:4222")
             addr = addr.split("://", 1)[-1]  # tolerate nats:// scheme

@@ -165,23 +165,36 @@ async def write_response(writer: asyncio.StreamWriter, response: ResponseData,
     await writer.drain()
 
 
+def make_ssl_context(cert_file: str, key_file: str):
+    """Server-side TLS context from a PEM cert/key pair — the
+    ListenAndServeTLS analog (reference pkg/gofr/http_server.go:82)."""
+    import ssl
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(certfile=cert_file, keyfile=key_file)
+    return ctx
+
+
 class HTTPServer:
-    """Owns the listen socket and the per-connection loop."""
+    """Owns the listen socket and the per-connection loop. Pass
+    ``ssl_context`` (see :func:`make_ssl_context`) to serve HTTPS."""
 
     def __init__(self, handler: Handler, *, host: str = "0.0.0.0", port: int = 8000,
-                 logger=None) -> None:
+                 logger=None, ssl_context=None) -> None:
         self.handler = handler
         self.host = host
         self.port = port
         self.logger = logger
+        self.ssl_context = ssl_context
         self._server: asyncio.AbstractServer | None = None
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
             self._serve_connection, self.host, self.port,
-            limit=MAX_HEADER_BYTES)
+            limit=MAX_HEADER_BYTES, ssl=self.ssl_context)
         if self.logger:
-            self.logger.info(f"HTTP server listening on {self.host}:{self.port}")
+            scheme = "https" if self.ssl_context else "http"
+            self.logger.info(
+                f"HTTP server listening on {scheme}://{self.host}:{self.port}")
 
     @property
     def bound_port(self) -> int:

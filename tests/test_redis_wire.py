@@ -1,0 +1,133 @@
+"""RESP2 network Redis client against the threaded mini server — real
+protocol bytes over a real socket (miniredis pattern, SURVEY §4)."""
+
+import pytest
+
+from gofr_tpu.config.env import DictConfig
+from gofr_tpu.datasource.redis import new_redis
+from gofr_tpu.datasource.redis_wire import (
+    MiniRedisServer,
+    RedisWire,
+    RESP2Error,
+    encode_command,
+)
+
+
+@pytest.fixture()
+def server():
+    srv = MiniRedisServer()
+    srv.start()
+    yield srv
+    srv.close()
+
+
+@pytest.fixture()
+def client(server):
+    c = RedisWire(host="127.0.0.1", port=server.port)
+    c.connect()
+    yield c
+    c.close()
+
+
+def test_encode_command_resp2_frame():
+    assert encode_command("SET", "k", "v") == \
+        b"*3\r\n$3\r\nSET\r\n$1\r\nk\r\n$1\r\nv\r\n"
+
+
+def test_strings_and_counters(client):
+    assert client.ping()
+    assert client.set("k", "hello")
+    assert client.get("k") == "hello"
+    assert client.get("missing") is None
+    assert client.incr("n") == 1
+    assert client.incr("n", 4) == 5
+    assert client.decr("n") == 4
+    assert client.delete("k", "n") == 2
+    assert client.exists("k") == 0
+
+
+def test_expiry_over_the_wire(client):
+    client.set("tmp", "x", ex=100)
+    assert 0 < client.ttl("tmp") <= 100
+    assert client.expire("tmp", 50)
+    assert client.ttl("tmp") <= 50
+    assert client.ttl("nope") == -2
+
+
+def test_hashes_lists_sets(client):
+    client.hset("h", "a", "1")
+    client.hset("h", "b", "2")
+    assert client.hget("h", "a") == "1"
+    assert client.hgetall("h") == {"a": "1", "b": "2"}
+    assert client.hdel("h", "a") == 1
+
+    client.rpush("l", "x", "y", "z")
+    assert client.llen("l") == 3
+    assert client.lrange("l", 0, -1) == ["x", "y", "z"]
+    assert client.lpop("l") == "x"
+    assert client.rpop("l") == "z"
+
+    client.sadd("s", "a", "b")
+    assert client.sismember("s", "a")
+    assert client.smembers("s") == {"a", "b"}
+    assert client.srem("s", "a") == 1
+
+
+def test_keys_and_flush(client):
+    client.set("user:1", "x")
+    client.set("user:2", "y")
+    client.set("other", "z")
+    assert sorted(client.keys("user:*")) == ["user:1", "user:2"]
+    assert client.flushdb()
+    assert client.keys() == []
+
+
+def test_server_error_is_raised_not_fatal(client):
+    client.set("str", "x")
+    with pytest.raises(RESP2Error):
+        client.execute("HGET", "no")  # wrong arity -> -ERR reply
+    # connection survives a server-side error
+    assert client.get("str") == "x"
+
+
+def test_wrongtype_error(client):
+    client.set("str", "x")
+    with pytest.raises(RESP2Error, match="WRONGTYPE"):
+        client.hset("str", "f", "v")
+
+
+def test_reconnects_after_server_restart(server, client):
+    client.set("k", "1")
+    server.close()
+    with pytest.raises((RESP2Error, OSError)):
+        client.get("k")
+    # replacement server (fresh port — TIME_WAIT keeps the old one);
+    # the client redials on next use
+    srv2 = MiniRedisServer()
+    srv2.start()
+    client.port = srv2.port
+    try:
+        srv2.engine.set("k", "2")
+        assert client.get("k") == "2"
+    finally:
+        srv2.close()
+
+
+def test_health_check_up_down(server, client):
+    assert client.health_check()["status"] == "UP"
+    server.close()
+    assert client.health_check()["status"] == "DOWN"
+
+
+def test_new_redis_mode_switch(server):
+    cfg = DictConfig({"REDIS_HOST": "127.0.0.1",
+                      "REDIS_PORT": str(server.port),
+                      "REDIS_MODE": "network"})
+    r = new_redis(cfg)
+    assert isinstance(r, RedisWire)
+    assert r.set("via-env", "ok") and r.get("via-env") == "ok"
+    r.close()
+
+    from gofr_tpu.datasource.redis import Redis
+    r2 = new_redis(DictConfig({"REDIS_HOST": "localhost"}))
+    assert isinstance(r2, Redis)
