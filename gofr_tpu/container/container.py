@@ -329,6 +329,15 @@ class Container:
                     await result
             except Exception as exc:
                 self.logger.warn(f"closing {attr}: {exc}")
+        # flush the trace exporter last: the spans of this shutdown are
+        # the ones a crash-loop investigation needs
+        exporter_close = getattr(getattr(self.tracer, "exporter", None),
+                                 "close", None)
+        if exporter_close is not None:
+            try:
+                exporter_close()
+            except Exception as exc:
+                self.logger.warn(f"closing trace exporter: {exc}")
 
 
 def _make_adder(slot: str):

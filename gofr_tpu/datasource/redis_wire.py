@@ -172,9 +172,11 @@ class RedisWire(ProviderMixin):
             self._reader = None
             self._connected = False
 
-    def execute(self, *args: Any) -> Any:
-        """One command round-trip under the observability hook."""
-        label = " ".join(str(a) for a in args[:2])
+    def execute(self, *args: Any, _label: str | None = None) -> Any:
+        """One command round-trip under the observability hook.
+        ``_label`` overrides the metric/log label when the wire command
+        differs from the surface method (INCRBY for incr, …)."""
+        label = _label or " ".join(str(a) for a in args[:2])
 
         def op():
             with self._lock:
@@ -225,8 +227,12 @@ class RedisWire(ProviderMixin):
         return bool(self.execute("EXPIRE", key, int(seconds)))
 
     def ttl(self, key): return self.execute("TTL", key)
-    def incr(self, key, by: int = 1): return self.execute("INCRBY", key, by)
-    def decr(self, key, by: int = 1): return self.execute("DECRBY", key, by)
+
+    def incr(self, key, by: int = 1):
+        return self.execute("INCRBY", key, by, _label=f"INCR {key}")
+
+    def decr(self, key, by: int = 1):
+        return self.execute("DECRBY", key, by, _label=f"DECR {key}")
 
     def hset(self, key, field, value):
         return self.execute("HSET", key, field, value)

@@ -1,0 +1,109 @@
+"""gRPC server reflection (grpc.reflection.v1alpha + v1), wire-
+compatible with grpcurl/evans — hand-encoded protobuf like
+:mod:`.health`, no grpc_reflection dependency.
+
+Reference analog: ``reflection.Register(g.server)`` gated on
+``GRPC_ENABLE_REFLECTION`` (reference pkg/gofr/grpc.go:130-134).
+
+Supported reflection requests: ``list_services`` returns every
+registered service (framework services + health + reflection itself);
+the descriptor-oriented requests (``file_containing_symbol`` etc.)
+answer ``NOT_FOUND`` — framework services declare JSON codecs in
+Python, so there are no compiled ``.proto`` descriptors to serve, and
+grpcurl falls back cleanly.
+
+Wire shapes used (v1alpha and v1 are field-identical):
+  ServerReflectionRequest  { string host = 1; oneof message_request {
+      string file_by_filename = 3; string file_containing_symbol = 4;
+      ... string list_services = 7; } }
+  ServerReflectionResponse { string valid_host = 1;
+      ServerReflectionRequest original_request = 2;
+      oneof message_response {
+        ListServiceResponse list_services_response = 6;
+        ErrorResponse error_response = 7; } }
+  ListServiceResponse { repeated ServiceResponse service = 1; }
+  ServiceResponse { string name = 1; }
+  ErrorResponse { int32 error_code = 1; string error_message = 2; }
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator, Callable, Iterable
+
+import grpc
+
+from .health import _decode_varint, _encode_varint
+
+NOT_FOUND = 5           # grpc.StatusCode.NOT_FOUND.value[0]
+UNIMPLEMENTED = 12
+
+#: request fields that carry the oneof discriminator
+_REQUEST_FIELDS = {3: "file_by_filename", 4: "file_containing_symbol",
+                   5: "file_containing_extension",
+                   6: "all_extension_numbers_of_type", 7: "list_services"}
+
+
+def decode_reflection_request(data: bytes) -> tuple[str, bytes, str]:
+    """-> (oneof field name, raw request bytes, argument string)."""
+    pos = 0
+    which, arg = "", ""
+    while pos < len(data):
+        tag, pos = _decode_varint(data, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 2:
+            length, pos = _decode_varint(data, pos)
+            value = data[pos:pos + length]
+            pos += length
+            if field in _REQUEST_FIELDS:
+                which = _REQUEST_FIELDS[field]
+                arg = value.decode("utf-8", "replace")
+        elif wire == 0:
+            _, pos = _decode_varint(data, pos)
+        else:
+            break
+    return which, data, arg
+
+
+def _field(num: int, payload: bytes) -> bytes:
+    return _encode_varint((num << 3) | 2) + _encode_varint(len(payload)) \
+        + payload
+
+
+def encode_list_services_response(request: bytes,
+                                  names: Iterable[str]) -> bytes:
+    services = b"".join(
+        _field(1, _field(1, name.encode())) for name in names)
+    return _field(2, request) + _field(6, services)
+
+
+def encode_error_response(request: bytes, code: int, message: str) -> bytes:
+    err = (_encode_varint(1 << 3) + _encode_varint(code)
+           + _field(2, message.encode()))
+    return _field(2, request) + _field(7, err)
+
+
+def reflection_handler(service_names: Callable[[], list[str]]):
+    """Generic handlers for both reflection service versions."""
+
+    async def info(request_iter, grpc_ctx) -> AsyncIterator[bytes]:
+        async for raw in request_iter:
+            which, original, _arg = decode_reflection_request(raw)
+            if which == "list_services":
+                yield encode_list_services_response(original,
+                                                    service_names())
+            elif which in ("file_by_filename", "file_containing_symbol",
+                           "file_containing_extension"):
+                yield encode_error_response(
+                    original, NOT_FOUND,
+                    "JSON-codec services carry no proto descriptors")
+            else:
+                yield encode_error_response(original, UNIMPLEMENTED,
+                                            f"unsupported: {which or '?'}")
+
+    handler = grpc.stream_stream_rpc_method_handler(
+        info, request_deserializer=lambda b: b,
+        response_serializer=lambda b: b)
+    return [grpc.method_handlers_generic_handler(
+        name, {"ServerReflectionInfo": handler})
+        for name in ("grpc.reflection.v1alpha.ServerReflection",
+                     "grpc.reflection.v1.ServerReflection")]

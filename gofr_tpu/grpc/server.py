@@ -232,6 +232,21 @@ class GRPCServer:
                 (grpc.method_handlers_generic_handler(service.name,
                                                       handlers),))
         self._server.add_generic_rpc_handlers((self._health_handlers(),))
+        # reflection, gated exactly as the reference gates it
+        # (GRPC_ENABLE_REFLECTION, reference grpc.go:130-134)
+        enabled = "false"
+        config = getattr(self.container, "config", None)
+        if config is not None:
+            enabled = config.get_or_default("GRPC_ENABLE_REFLECTION",
+                                            "false").lower()
+        if enabled == "true":
+            from .reflection import reflection_handler
+            names = [s.name for s in self._services] + [
+                "grpc.health.v1.Health",
+                "grpc.reflection.v1alpha.ServerReflection",
+                "grpc.reflection.v1.ServerReflection"]
+            self._server.add_generic_rpc_handlers(
+                tuple(reflection_handler(lambda: sorted(names))))
         self.bound_port = self._server.add_insecure_port(
             f"0.0.0.0:{self.port}")
         await self._server.start()

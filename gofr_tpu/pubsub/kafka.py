@@ -204,6 +204,8 @@ class KafkaClient:
         self._connect_lock = asyncio.Lock()
         self._connected = False
         self._consumers: dict[tuple[str, str], _GroupConsumer] = {}
+        self._topic_parts: dict[str, int] = {}   # publish routing cache
+        self._rr = itertools.count()
 
     def use_logger(self, logger: Any) -> None:
         self.logger = logger
@@ -241,7 +243,15 @@ class KafkaClient:
                     self._writer.close()
                 except Exception:
                     pass
-            self._consumers.clear()  # memberships died with the socket
+            # memberships died with the socket: reset IN PLACE so any
+            # in-flight subscribe() loop holding a state object rejoins
+            # the same _GroupConsumer instead of orphaning a ghost
+            # member that nobody heartbeats
+            for state in self._consumers.values():
+                state.joined = False
+                state.member_id = ""
+                state.generation = -1
+                state.partitions = []
             await self.connect()
 
     async def _call(self, api_key: int, body: bytes,
@@ -285,8 +295,18 @@ class KafkaClient:
         if self.metrics is not None:
             self.metrics.increment_counter("app_pubsub_publish_total_count",
                                            topic=topic)
+        # route like the reference writer's balancer (kafka.go): keyed
+        # messages hash to a stable partition, unkeyed round-robin
+        n_parts = self._topic_parts.get(topic)
+        if n_parts is None:
+            parts = await self._partitions_for(topic)
+            n_parts = self._topic_parts[topic] = max(1, len(parts))
+        if key:
+            pid = zlib.crc32(key.encode()) % n_parts
+        else:
+            pid = next(self._rr) % n_parts
         mset = _encode_message_set([(key.encode() if key else None, value)])
-        part = _i32(0) + _i32(len(mset)) + mset
+        part = _i32(pid) + _i32(len(mset)) + mset
         body = (_i16(1) + _i32(10000)            # acks=1, timeout
                 + _array([_str(topic) + _array([part])]))
         r = await self._call(PRODUCE, body)

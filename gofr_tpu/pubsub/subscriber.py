@@ -21,8 +21,15 @@ class SubscriptionManager:
         self.container = container
 
     async def start_subscriber(self, topic: str, handler: Callable,
-                               group: str = "default") -> None:
-        """Infinite consume loop for one topic (one asyncio task)."""
+                               group: str | None = None) -> None:
+        """Infinite consume loop for one topic (one asyncio task).
+
+        ``group`` defaults to the configured consumer group
+        (``CONSUMER_GROUP``/``KAFKA_CONSUMER_GROUP``), falling back to
+        "default" — so apps with distinct configured groups never share
+        offsets (reference kafka.go ConsumerGroupID semantics)."""
+        if group is None:
+            group = self._default_group()
         while True:
             try:
                 await self.handle_one(topic, handler, group)
@@ -34,9 +41,19 @@ class SubscriptionManager:
                     f"{ERROR_BACKOFF_S}s")
                 await asyncio.sleep(ERROR_BACKOFF_S)
 
+    def _default_group(self) -> str:
+        config = getattr(self.container, "config", None)
+        if config is None:
+            return "default"
+        return config.get_or_default(
+            "CONSUMER_GROUP",
+            config.get_or_default("KAFKA_CONSUMER_GROUP", "default"))
+
     async def handle_one(self, topic: str, handler: Callable,
-                         group: str = "default") -> None:
+                         group: str | None = None) -> None:
         """Consume and handle exactly one message (test-friendly)."""
+        if group is None:
+            group = self._default_group()
         pubsub = self.container.pubsub
         if pubsub is None:
             raise RuntimeError("no pub/sub client configured")

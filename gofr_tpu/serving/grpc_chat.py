@@ -1,0 +1,84 @@
+"""gRPC chat service: streaming token generation over grpc.aio.
+
+BASELINE config 3 serves /chat over gRPC streaming; this is that
+surface — the gRPC twin of ``handlers.make_chat_handler`` (SSE), fed
+by the same continuous-batching engine. JSON codec by default (any
+gRPC client sending JSON bytes interoperates; grpcurl works with
+``-d '{"prompt": ...}'`` against the reflection listing).
+
+RPCs (service ``gofr.serving.Chat``):
+- ``Stream`` (server-streaming): one message per token
+  ``{"token": int, "text": str}`` then a terminal ``{"done": true,
+  "usage": {...}}``.
+- ``Complete`` (unary): the full completion in one message, same shape
+  as the HTTP handler's response.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, AsyncIterator
+
+from ..grpc.service import GRPCService, rpc, server_stream_rpc
+from .engine import Engine, SamplingParams
+
+
+def _params_from(req: dict) -> tuple[str, SamplingParams]:
+    prompt = req.get("prompt")
+    if not prompt and isinstance(req.get("messages"), list):
+        prompt = "\n".join(str(m.get("content", ""))
+                           for m in req["messages"])
+    if not prompt or not isinstance(prompt, str):
+        raise ValueError("prompt required")
+    max_new = int(req.get("max_tokens", req.get("max_new_tokens", 128)))
+    if not 1 <= max_new <= 4096:
+        raise ValueError("max_tokens out of range")
+    return prompt, SamplingParams(
+        temperature=float(req.get("temperature", 0.7)),
+        top_p=float(req.get("top_p", 1.0)),
+        top_k=int(req.get("top_k", 0)),
+        max_new_tokens=max_new)
+
+
+def make_chat_service(engine: Engine, tokenizer: Any) -> GRPCService:
+    """Build the registered service instance for ``app.register_grpc``."""
+
+    class ChatService(GRPCService):
+        name = "gofr.serving.Chat"
+
+        @server_stream_rpc
+        async def Stream(self, ctx, request) -> AsyncIterator[dict]:
+            prompt, params = _params_from(request or {})
+            prompt_tokens = tokenizer.encode(prompt)
+            start = time.perf_counter()
+            n = 0
+            async for token in engine.generate_stream(prompt_tokens,
+                                                      params):
+                n += 1
+                yield {"token": token, "text": tokenizer.decode([token])}
+            yield {"done": True,
+                   "usage": {"prompt_tokens": len(prompt_tokens),
+                             "completion_tokens": n,
+                             "duration_ms": round(
+                                 (time.perf_counter() - start) * 1e3, 2)}}
+
+        @rpc
+        async def Complete(self, ctx, request) -> dict:
+            prompt, params = _params_from(request or {})
+            prompt_tokens = tokenizer.encode(prompt)
+            req = engine.submit(prompt_tokens, params)
+            tokens: list[int] = []
+            while True:
+                token = await req.out_queue.get()
+                if token is None:
+                    break
+                tokens.append(token)
+            if req.error:
+                raise RuntimeError(f"generation failed: {req.error}")
+            return {"text": tokenizer.decode(tokens), "tokens": tokens,
+                    "usage": {"prompt_tokens": len(prompt_tokens),
+                              "completion_tokens": len(tokens),
+                              "ttft_ms": round(req.ttft_ms, 2)
+                              if req.ttft_ms else None}}
+
+    return ChatService()

@@ -1,0 +1,172 @@
+"""gRPC reflection (GRPC_ENABLE_REFLECTION gate, reference
+grpc.go:130-134) and the streaming chat service (BASELINE config 3's
+gRPC surface)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import grpc as grpc_lib
+
+from gofr_tpu.grpc.reflection import (
+    decode_reflection_request,
+    encode_list_services_response,
+)
+from gofr_tpu.serving.engine import EngineConfig
+from gofr_tpu.serving.glue import demo_llama_engine
+from gofr_tpu.serving.grpc_chat import make_chat_service
+from gofr_tpu.serving.tokenizer import ByteTokenizer
+from gofr_tpu.grpc.health import _decode_varint
+
+from .apputil import AppRunner
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+def _reflection_request_list_services() -> bytes:
+    # field 7 (list_services), wire type 2, empty string
+    return bytes([7 << 3 | 2, 0])
+
+
+def _parse_list_services(data: bytes) -> list[str]:
+    """Walk ServerReflectionResponse -> list_services_response(6) ->
+    service(1) -> name(1)."""
+    names = []
+    pos = 0
+    while pos < len(data):
+        tag, pos = _decode_varint(data, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire != 2:
+            _, pos = _decode_varint(data, pos)
+            continue
+        length, pos = _decode_varint(data, pos)
+        payload = data[pos:pos + length]
+        pos += length
+        if field == 6:  # ListServiceResponse
+            spos = 0
+            while spos < len(payload):
+                stag, spos = _decode_varint(payload, spos)
+                slen, spos = _decode_varint(payload, spos)
+                svc = payload[spos:spos + slen]
+                spos += slen
+                if stag >> 3 == 1:
+                    npos = 0
+                    ntag, npos = _decode_varint(svc, npos)
+                    nlen, npos = _decode_varint(svc, npos)
+                    names.append(svc[npos:npos + nlen].decode())
+    return names
+
+
+def test_reflection_codec_roundtrip():
+    req = _reflection_request_list_services()
+    which, original, arg = decode_reflection_request(req)
+    assert which == "list_services" and original == req
+    resp = encode_list_services_response(req, ["a.B", "c.D"])
+    assert _parse_list_services(resp) == ["a.B", "c.D"]
+
+
+def _build_chat(app):
+    engine = demo_llama_engine(EngineConfig(max_batch=2, max_seq=64,
+                                            seed=3))
+    engine.start()
+    app._test_engine = engine
+    app.register_grpc_service(make_chat_service(engine, ByteTokenizer()))
+
+
+def test_reflection_lists_services_over_the_wire():
+    cfg = {"GRPC_PORT": "0", "GRPC_ENABLE_REFLECTION": "true"}
+    with AppRunner(build=_build_chat, config=cfg) as r:
+        port = r.app.grpc_server.bound_port
+
+        async def go():
+            channel = grpc_lib.aio.insecure_channel(f"127.0.0.1:{port}")
+            for svc in ("grpc.reflection.v1alpha.ServerReflection",
+                        "grpc.reflection.v1.ServerReflection"):
+                method = channel.stream_stream(
+                    f"/{svc}/ServerReflectionInfo",
+                    request_serializer=lambda b: b,
+                    response_deserializer=lambda b: b)
+                call = method(iter([_reflection_request_list_services()]))
+                names = []
+                async for raw in call:
+                    names = _parse_list_services(raw)
+                    break
+                assert "gofr.serving.Chat" in names
+                assert "grpc.health.v1.Health" in names
+                assert svc in names
+            await channel.close()
+        run(go())
+    r.app._test_engine.stop()
+
+
+def test_reflection_disabled_by_default():
+    with AppRunner(build=_build_chat, config={"GRPC_PORT": "0"}) as r:
+        port = r.app.grpc_server.bound_port
+
+        async def go():
+            channel = grpc_lib.aio.insecure_channel(f"127.0.0.1:{port}")
+            method = channel.stream_stream(
+                "/grpc.reflection.v1alpha.ServerReflection"
+                "/ServerReflectionInfo",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b)
+            call = method(iter([_reflection_request_list_services()]))
+            try:
+                async for _ in call:
+                    raise AssertionError("reflection answered while off")
+            except grpc_lib.aio.AioRpcError as exc:
+                assert exc.code() == grpc_lib.StatusCode.UNIMPLEMENTED
+            await channel.close()
+        run(go())
+    r.app._test_engine.stop()
+
+
+def test_grpc_chat_streaming_tokens():
+    with AppRunner(build=_build_chat, config={"GRPC_PORT": "0"}) as r:
+        port = r.app.grpc_server.bound_port
+
+        async def go():
+            channel = grpc_lib.aio.insecure_channel(f"127.0.0.1:{port}")
+            method = channel.unary_stream(
+                "/gofr.serving.Chat/Stream",
+                request_serializer=lambda o: json.dumps(o).encode(),
+                response_deserializer=lambda b: json.loads(b))
+            events = [e async for e in method(
+                {"prompt": "stream me", "max_tokens": 6,
+                 "temperature": 0.0})]
+            tokens = [e for e in events if "token" in e]
+            assert len(tokens) == 6
+            assert events[-1]["done"] is True
+            assert events[-1]["usage"]["completion_tokens"] == 6
+            await channel.close()
+        run(go())
+    r.app._test_engine.stop()
+
+
+def test_grpc_chat_unary_complete_matches_stream():
+    with AppRunner(build=_build_chat, config={"GRPC_PORT": "0"}) as r:
+        port = r.app.grpc_server.bound_port
+
+        async def go():
+            channel = grpc_lib.aio.insecure_channel(f"127.0.0.1:{port}")
+            unary = channel.unary_unary(
+                "/gofr.serving.Chat/Complete",
+                request_serializer=lambda o: json.dumps(o).encode(),
+                response_deserializer=lambda b: json.loads(b))
+            streaming = channel.unary_stream(
+                "/gofr.serving.Chat/Stream",
+                request_serializer=lambda o: json.dumps(o).encode(),
+                response_deserializer=lambda b: json.loads(b))
+            req = {"prompt": "same greedy", "max_tokens": 5,
+                   "temperature": 0.0}
+            whole = await unary(req)
+            streamed = [e["token"] async for e in streaming(req)
+                        if "token" in e]
+            assert whole["tokens"] == streamed
+            assert whole["usage"]["completion_tokens"] == 5
+            await channel.close()
+        run(go())
+    r.app._test_engine.stop()

@@ -120,14 +120,9 @@ async def test_consumer_group_partitions_balance():
         t2 = asyncio.ensure_future(c2.subscribe("evt", "g"))
         await asyncio.sleep(0.3)
 
-        # publish one message to each partition
-        from gofr_tpu.pubsub.kafka import _array, _encode_message_set, \
-            _i16, _i32, _str, PRODUCE
-        for pid, payload in ((0, b"p0"), (1, b"p1")):
-            mset = _encode_message_set([(None, payload)])
-            body = (_i16(1) + _i32(1000) + _array(
-                [_str("evt") + _array([_i32(pid) + _i32(len(mset)) + mset])]))
-            await pub._call(PRODUCE, body)
+        # unkeyed publishes round-robin across the two partitions
+        await pub.publish("evt", "p0")
+        await pub.publish("evt", "p1")
 
         got = {(await asyncio.wait_for(t1, 10)).value,
                (await asyncio.wait_for(t2, 10)).value}
@@ -205,3 +200,40 @@ async def test_container_wires_kafka_backend():
     finally:
         await c.pubsub.close()
         await broker.close()
+
+
+@async_test
+async def test_keyed_publish_routes_stably():
+    """Same key -> same partition (ordering per key), different keys
+    spread (reference kafka.go writer balancer semantics)."""
+    broker = MiniKafkaBroker(default_partitions=4)
+    await broker.start()
+    pub = KafkaClient(brokers=f"127.0.0.1:{broker.port}")
+    try:
+        await pub.create_topic_async("keyed", partitions=4)
+        for _ in range(3):
+            await pub.publish("keyed", "a", key="user-1")
+        sizes = [len(p) for p in broker.logs["keyed"]]
+        assert sorted(sizes) == [0, 0, 0, 3]   # all three on ONE partition
+    finally:
+        await pub.close()
+        await broker.close()
+
+
+def test_subscriber_group_defaults_from_config():
+    from gofr_tpu.pubsub.subscriber import SubscriptionManager
+
+    class FakeContainer:
+        config = DictConfig({"KAFKA_CONSUMER_GROUP": "workers"})
+
+    assert SubscriptionManager(FakeContainer())._default_group() == "workers"
+
+    class Generic:
+        config = DictConfig({"CONSUMER_GROUP": "generic"})
+
+    assert SubscriptionManager(Generic())._default_group() == "generic"
+
+    class Bare:
+        config = DictConfig({})
+
+    assert SubscriptionManager(Bare())._default_group() == "default"
