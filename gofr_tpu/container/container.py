@@ -111,10 +111,24 @@ class Container:
         c.redis = new_redis(config, logger, c.metrics, c.tracer)
 
         # pub/sub backend switch (reference container.go:132-172 selects
-        # KAFKA/GOOGLE/MQTT from PUBSUB_BACKEND; ours:
-        # KAFKA/NATS/MQTT/MEMORY)
+        # KAFKA/GOOGLE/MQTT from PUBSUB_BACKEND; ours: KAFKA/GOOGLE/
+        # EVENTHUB/NATS/JETSTREAM/MQTT/MEMORY)
         backend = config.get_or_default("PUBSUB_BACKEND", "").upper()
-        if backend == "KAFKA":
+        if backend == "GOOGLE":
+            from ..pubsub.google import GooglePubSubClient
+            c.add_pubsub(GooglePubSubClient(
+                endpoint=config.get_or_default("PUBSUB_BROKER",
+                                               "127.0.0.1:8085"),
+                project=config.get_or_default("GOOGLE_PROJECT_ID", "gofr")))
+        elif backend == "EVENTHUB":
+            from ..pubsub.eventhub import EventHubClient
+            c.add_pubsub(EventHubClient(
+                namespace=config.get_or_default("PUBSUB_BROKER",
+                                                "127.0.0.1:9092"),
+                eventhub=config.get_or_default("EVENTHUB_NAME", ""),
+                consumer_group=config.get_or_default(
+                    "KAFKA_CONSUMER_GROUP", "$Default")))
+        elif backend == "KAFKA":
             from ..pubsub.kafka import KafkaClient
             c.add_pubsub(KafkaClient(
                 brokers=config.get_or_default("PUBSUB_BROKER",
@@ -124,8 +138,7 @@ class Container:
                 client_id=c.app_name,
                 auto_offset=config.get_or_default(
                     "KAFKA_AUTO_OFFSET", "earliest").lower()))
-        elif backend == "NATS":
-            from ..pubsub.nats import NATSClient
+        elif backend in ("NATS", "JETSTREAM"):
             addr = config.get_or_default("PUBSUB_BROKER", "127.0.0.1:4222")
             addr = addr.split("://", 1)[-1]  # tolerate nats:// scheme
             host, _, port_s = addr.rpartition(":")
@@ -133,8 +146,14 @@ class Container:
                 port = int(port_s)
             except ValueError:
                 host, port = addr, 4222  # bare hostname, default port
-            c.add_pubsub(NATSClient(host or "127.0.0.1", port,
-                                    name=c.app_name))
+            if backend == "JETSTREAM":
+                from ..pubsub.jetstream import JetStreamClient
+                c.add_pubsub(JetStreamClient(host or "127.0.0.1", port,
+                                             name=c.app_name))
+            else:
+                from ..pubsub.nats import NATSClient
+                c.add_pubsub(NATSClient(host or "127.0.0.1", port,
+                                        name=c.app_name))
         elif backend == "MQTT":
             from ..pubsub.mqtt import MQTTClient
             try:

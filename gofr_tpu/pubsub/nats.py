@@ -113,12 +113,13 @@ class NATSClient:
                     # MSG <subject> <sid> [reply-to] <#bytes>
                     subject = parts[0].decode()
                     sid = int(parts[1])
+                    reply = parts[2].decode() if len(parts) == 4 else ""
                     nbytes = int(parts[-1])
                     payload = await self._reader.readexactly(nbytes)
                     await self._reader.readexactly(2)  # trailing \r\n
                     queue = self._queues.get(sid)
                     if queue is not None:
-                        await queue.put((subject, payload))
+                        await queue.put((subject, reply, payload))
                 elif line.startswith(b"PING"):
                     if self._writer is not None:
                         self._writer.write(b"PONG\r\n")
@@ -200,7 +201,7 @@ class NATSClient:
             # connection died while blocked; the subscriber runtime's
             # backoff loop retries subscribe(), which reconnects
             raise NATSError("connection lost")
-        subject, payload = item
+        subject, _reply, payload = item
         if self.metrics is not None:
             self.metrics.increment_counter("app_pubsub_subscribe_total_count",
                                            topic=topic)
@@ -293,17 +294,26 @@ class MiniNATSServer:
                                   if not (s[0] == conn_id and s[1] == sid)]
                 elif verb == b"PUB":
                     parts = line.decode().strip().split()
+                    # PUB <subject> [reply-to] <#bytes>
                     subject, nbytes = parts[1], int(parts[-1])
+                    reply = parts[2] if len(parts) == 4 else ""
                     payload = await reader.readexactly(nbytes)
                     await reader.readexactly(2)
-                    await self._route(subject, payload)
+                    await self._publish(subject, reply, payload)
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
         finally:
             self._conns.pop(conn_id, None)
             self._subs = [s for s in self._subs if s[0] != conn_id]
 
-    async def _route(self, subject: str, payload: bytes) -> None:
+    async def _publish(self, subject: str, reply: str,
+                       payload: bytes) -> None:
+        """One inbound PUB; the JetStream subclass intercepts API
+        subjects and stream captures here."""
+        await self._route(subject, payload, reply=reply)
+
+    async def _route(self, subject: str, payload: bytes,
+                     reply: str = "") -> None:
         matched = [s for s in self._subs if subject_matches(s[2], subject)]
         # queue groups get one member each; plain subs all get a copy
         by_group: dict[str, list] = {}
@@ -319,8 +329,10 @@ class MiniNATSServer:
             writer = self._conns.get(conn_id)
             if writer is None:
                 continue
-            writer.write(f"MSG {subject} {sid} {len(payload)}\r\n".encode()
-                         + payload + b"\r\n")
+            reply_part = f" {reply}" if reply else ""
+            writer.write(
+                f"MSG {subject} {sid}{reply_part} {len(payload)}\r\n"
+                .encode() + payload + b"\r\n")
             try:
                 await writer.drain()
             except ConnectionError:

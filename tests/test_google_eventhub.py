@@ -1,0 +1,141 @@
+"""Google Pub/Sub REST backend against the in-process emulator, and
+the Event Hubs adapter over the Kafka endpoint (reference
+datasource/pubsub/google + eventhub modules)."""
+
+import asyncio
+import functools
+
+from gofr_tpu.config.env import DictConfig
+from gofr_tpu.container.container import Container
+from gofr_tpu.pubsub.eventhub import EventHubClient
+from gofr_tpu.pubsub.google import GooglePubSubClient, MiniPubSubEmulator
+from gofr_tpu.pubsub.kafka import MiniKafkaBroker
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return asyncio.run(fn(*args, **kwargs))
+    return wrapper
+
+
+@async_test
+async def test_publish_pull_ack_roundtrip():
+    emu = MiniPubSubEmulator()
+    await emu.start()
+    client = GooglePubSubClient(f"127.0.0.1:{emu.port}", project="p")
+    try:
+        # real Pub/Sub delivers only to subscriptions that exist at
+        # publish time; apps create them at boot (the subscriber
+        # runtime pulls from startup), tests do it explicitly
+        await client._ensure_subscription("orders", "g-orders")
+        await client.publish("orders", {"id": 7}, key="k",
+                             metadata={"source": "web"})
+        msg = await asyncio.wait_for(client.subscribe("orders", "g"), 10)
+        assert msg.bind() == {"id": 7}
+        assert msg.key == "k"
+        assert msg.metadata["source"] == "web"
+        msg.commit()
+        await asyncio.sleep(0.05)
+        assert not emu.subs["g-orders"]["outstanding"]
+    finally:
+        await client.close()
+        await emu.close()
+
+
+@async_test
+async def test_groups_fan_out_but_compete_within():
+    """Each group (subscription) sees every message once; consumers in
+    one group compete."""
+    emu = MiniPubSubEmulator()
+    await emu.start()
+    client = GooglePubSubClient(f"127.0.0.1:{emu.port}")
+    try:
+        # create both subscriptions BEFORE publishing (pub/sub fan-out
+        # starts at subscription creation, as in the real service)
+        await client._ensure_subscription("evt", "a-evt")
+        await client._ensure_subscription("evt", "b-evt")
+        await client.publish("evt", "x")
+        m1 = await asyncio.wait_for(client.subscribe("evt", "a"), 10)
+        m2 = await asyncio.wait_for(client.subscribe("evt", "b"), 10)
+        assert m1.value == b"x" and m2.value == b"x"
+    finally:
+        await client.close()
+        await emu.close()
+
+
+@async_test
+async def test_unacked_message_redelivers_after_deadline():
+    emu = MiniPubSubEmulator()
+    await emu.start()
+    client = GooglePubSubClient(f"127.0.0.1:{emu.port}", ack_deadline_s=1)
+    try:
+        await client._ensure_subscription("t", "g-t")
+        await client.publish("t", "poison")
+        m = await asyncio.wait_for(client.subscribe("t", "g"), 10)
+        assert m.value == b"poison"   # received but NOT acked
+        await asyncio.sleep(1.1)      # deadline passes
+        m2 = await asyncio.wait_for(client.subscribe("t", "g"), 10)
+        assert m2.value == b"poison"
+        m2.commit()
+    finally:
+        await client.close()
+        await emu.close()
+
+
+@async_test
+async def test_container_wires_google_backend():
+    emu = MiniPubSubEmulator()
+    await emu.start()
+    c = Container.create(DictConfig({
+        "APP_NAME": "gp", "PUBSUB_BACKEND": "GOOGLE",
+        "PUBSUB_BROKER": f"127.0.0.1:{emu.port}",
+        "GOOGLE_PROJECT_ID": "proj-x"}))
+    try:
+        assert isinstance(c.pubsub, GooglePubSubClient)
+        assert c.pubsub.project == "proj-x"
+        await c.pubsub._ensure_subscription("t", "w-t")
+        await c.pubsub.publish("t", {"ok": 1})
+        msg = await asyncio.wait_for(c.pubsub.subscribe("t", "w"), 10)
+        assert msg.bind() == {"ok": 1}
+        assert c.pubsub.health_check()["status"] == "UP"
+    finally:
+        await c.pubsub.close()
+        await emu.close()
+
+
+@async_test
+async def test_eventhub_adapter_over_kafka_endpoint():
+    broker = MiniKafkaBroker()
+    await broker.start()
+    client = EventHubClient(namespace=f"127.0.0.1:{broker.port}",
+                            eventhub="telemetry", consumer_group="$Default")
+    try:
+        await client.publish(value={"reading": 42})  # default hub
+        msg = await asyncio.wait_for(client.subscribe(), 15)
+        assert msg.topic == "telemetry"
+        assert msg.bind() == {"reading": 42}
+        health = client.health_check()
+        assert health["backend"] == "eventhub"
+        assert health["details"]["eventhub"] == "telemetry"
+    finally:
+        await client.close()
+        await broker.close()
+
+
+@async_test
+async def test_container_wires_eventhub_backend():
+    broker = MiniKafkaBroker()
+    await broker.start()
+    c = Container.create(DictConfig({
+        "APP_NAME": "eh", "PUBSUB_BACKEND": "EVENTHUB",
+        "PUBSUB_BROKER": f"127.0.0.1:{broker.port}",
+        "EVENTHUB_NAME": "ingest"}))
+    try:
+        assert isinstance(c.pubsub, EventHubClient)
+        await c.pubsub.publish(value="ping")
+        msg = await asyncio.wait_for(c.pubsub.subscribe(), 15)
+        assert msg.value == b"ping" and msg.topic == "ingest"
+    finally:
+        await c.pubsub.close()
+        await broker.close()

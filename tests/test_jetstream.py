@@ -1,0 +1,172 @@
+"""JetStream semantics over the mini server: persistence, durable pull
+consumers, explicit acks, ack-wait redelivery (VERDICT missing #6 —
+the reference NATS module's JetStream grade)."""
+
+import asyncio
+import functools
+
+from gofr_tpu.config.env import DictConfig
+from gofr_tpu.container.container import Container
+from gofr_tpu.pubsub.jetstream import JetStreamClient, MiniJetStreamServer
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return asyncio.run(fn(*args, **kwargs))
+    return wrapper
+
+
+@async_test
+async def test_publish_gets_puback_and_persists():
+    srv = MiniJetStreamServer()
+    await srv.start()
+    client = JetStreamClient(port=srv.port)
+    try:
+        await client.publish("orders", {"id": 1})
+        await client.publish("orders", {"id": 2})
+        assert len(srv.streams["orders"].messages) == 2  # persisted
+    finally:
+        await client.close()
+        await srv.close()
+
+
+@async_test
+async def test_pull_consume_ack_ordering():
+    srv = MiniJetStreamServer()
+    await srv.start()
+    client = JetStreamClient(port=srv.port)
+    try:
+        await client.publish("t", "a")
+        await client.publish("t", "b")
+        m1 = await asyncio.wait_for(client.subscribe("t", "workers"), 10)
+        assert m1.value == b"a"
+        m1.commit()
+        m2 = await asyncio.wait_for(client.subscribe("t", "workers"), 10)
+        assert m2.value == b"b"
+        m2.commit()
+        await asyncio.sleep(0.05)
+        consumer = srv.consumers[("t", "workers")]
+        assert not consumer.outstanding          # both acked
+    finally:
+        await client.close()
+        await srv.close()
+
+
+@async_test
+async def test_unacked_redelivers_after_ack_wait():
+    srv = MiniJetStreamServer()
+    await srv.start()
+    client = JetStreamClient(port=srv.port, ack_wait_s=0.3)
+    try:
+        await client.publish("t", "poison")
+        m = await asyncio.wait_for(client.subscribe("t", "g"), 10)
+        assert m.value == b"poison"              # delivered, NOT acked
+        await asyncio.sleep(0.4)                 # ack-wait expires
+        m2 = await asyncio.wait_for(client.subscribe("t", "g"), 10)
+        assert m2.value == b"poison"             # redelivered
+        m2.commit()
+        await asyncio.sleep(0.05)
+        assert not srv.consumers[("t", "g")].outstanding
+    finally:
+        await client.close()
+        await srv.close()
+
+
+@async_test
+async def test_consumer_survives_client_restart():
+    """Durability: a new client resumes the durable's cursor — acked
+    messages never redeliver across restarts."""
+    srv = MiniJetStreamServer()
+    await srv.start()
+    c1 = JetStreamClient(port=srv.port)
+    await c1.publish("t", "one")
+    await c1.publish("t", "two")
+    m = await asyncio.wait_for(c1.subscribe("t", "d"), 10)
+    assert m.value == b"one"
+    m.commit()
+    await asyncio.sleep(0.05)
+    await c1.close()
+
+    c2 = JetStreamClient(port=srv.port)
+    try:
+        m = await asyncio.wait_for(c2.subscribe("t", "d"), 10)
+        assert m.value == b"two"
+    finally:
+        await c2.close()
+        await srv.close()
+
+
+@async_test
+async def test_two_groups_each_get_every_message():
+    srv = MiniJetStreamServer()
+    await srv.start()
+    client = JetStreamClient(port=srv.port)
+    try:
+        await client.publish("evt", "x")
+        a = await asyncio.wait_for(client.subscribe("evt", "a"), 10)
+        b = await asyncio.wait_for(client.subscribe("evt", "b"), 10)
+        assert a.value == b"x" and b.value == b"x"
+    finally:
+        await client.close()
+        await srv.close()
+
+
+@async_test
+async def test_container_wires_jetstream_backend():
+    srv = MiniJetStreamServer()
+    await srv.start()
+    c = Container.create(DictConfig({
+        "APP_NAME": "js", "PUBSUB_BACKEND": "JETSTREAM",
+        "PUBSUB_BROKER": f"127.0.0.1:{srv.port}"}))
+    try:
+        assert isinstance(c.pubsub, JetStreamClient)
+        await c.pubsub.publish("t", {"n": 1})
+        msg = await asyncio.wait_for(c.pubsub.subscribe("t", "g"), 10)
+        assert msg.bind() == {"n": 1}
+        assert c.pubsub.health_check()["backend"] == "nats-jetstream"
+    finally:
+        await c.pubsub.close()
+        await srv.close()
+
+
+@async_test
+async def test_dotted_subjects_work():
+    """Idiomatic NATS subjects ('orders.created') must map to legal
+    stream/durable names while the stream captures the dotted subject."""
+    srv = MiniJetStreamServer()
+    await srv.start()
+    client = JetStreamClient(port=srv.port)
+    try:
+        await client.publish("orders.created", {"id": 9})
+        m = await asyncio.wait_for(
+            client.subscribe("orders.created", "eu.workers"), 10)
+        assert m.bind() == {"id": 9}
+        m.commit()
+        assert "orders_created" in srv.streams
+    finally:
+        await client.close()
+        await srv.close()
+
+
+@async_test
+async def test_subscribe_recovers_after_connection_drop():
+    srv = MiniJetStreamServer()
+    await srv.start()
+    client = JetStreamClient(port=srv.port)
+    try:
+        await client.publish("t", "before")
+        m = await asyncio.wait_for(client.subscribe("t", "g"), 10)
+        assert m.value == b"before"
+        m.commit()
+        await asyncio.sleep(0.05)
+        # server drops every connection; streams live server-side
+        for w in list(srv._conns.values()):
+            w.close()
+        await asyncio.sleep(0.05)
+        await client.publish("t", "after")
+        m2 = await asyncio.wait_for(client.subscribe("t", "g"), 10)
+        assert m2.value == b"after"
+    finally:
+        await client.close()
+        await srv.close()
