@@ -1,0 +1,108 @@
+"""InfluxDB HTTP/line-protocol client against the mini server — real
+wire bytes over a real socket (reference datasource/influxdb's
+network-client role)."""
+
+import pytest
+
+from gofr_tpu.datasource.influx_wire import (
+    InfluxWire,
+    MiniInfluxServer,
+    decode_line,
+    encode_line,
+)
+from gofr_tpu.datasource.timeseries import TimeseriesError
+
+
+# --------------------------------------------------------- line protocol
+
+def test_line_protocol_roundtrip():
+    line = encode_line("cpu", {"usage": 42.5}, {"host": "a b", "dc": "eu"},
+                       ts=1700000000.123)
+    measurement, tags, fields, ts = decode_line(line)
+    assert measurement == "cpu"
+    assert tags == {"host": "a b", "dc": "eu"}
+    assert fields == {"usage": 42.5}
+    assert ts == pytest.approx(1700000000.123, abs=1e-6)
+
+
+def test_line_protocol_escaping():
+    line = encode_line("my measure", {"v": 1.0}, {"k=1": "x,y"})
+    measurement, tags, fields, _ = decode_line(line)
+    assert measurement == "my measure"
+    assert tags == {"k=1": "x,y"}
+
+
+def test_line_requires_fields():
+    with pytest.raises(TimeseriesError):
+        encode_line("m", {})
+
+
+# ------------------------------------------------------------- end-to-end
+
+@pytest.fixture()
+def server():
+    srv = MiniInfluxServer()
+    srv.start()
+    yield srv
+    srv.close()
+
+
+@pytest.fixture()
+def client(server):
+    c = InfluxWire(url=f"127.0.0.1:{server.port}")
+    c.connect()
+    yield c
+    c.close()
+
+
+def test_write_query_roundtrip(client):
+    client.create_bucket("metrics")
+    client.write_point("metrics", "cpu", 100.0, {"usage": 0.5},
+                       {"host": "a"})
+    client.write_point("metrics", "cpu", 200.0, {"usage": 0.9},
+                       {"host": "b"})
+    points = client.query("metrics", "cpu", "usage")
+    assert points == [(100.0, 0.5), (200.0, 0.9)]
+    # range + tag filters ride the InfluxQL WHERE clause
+    assert client.query("metrics", "cpu", "usage", start=150.0) == \
+        [(200.0, 0.9)]
+    assert client.query("metrics", "cpu", "usage",
+                        tags={"host": "a"}) == [(100.0, 0.5)]
+
+
+def test_aggregates(client):
+    client.create_bucket("m")
+    for i, v in enumerate([1.0, 2.0, 3.0]):
+        client.write_point("m", "t", float(i), {"v": v})
+    assert client.aggregate("m", "t", "v", "sum") == 6.0
+    assert client.aggregate("m", "t", "v", "avg") == 2.0
+    assert client.aggregate("m", "t", "v", "max") == 3.0
+    assert client.aggregate("m", "t", "v", "count") == 3
+    assert client.aggregate("m", "t", "v", "avg", start=1.0) == 2.5
+    assert client.aggregate("m", "nothing", "v", "avg") is None
+
+
+def test_bucket_admin(client):
+    client.create_bucket("a")
+    client.create_bucket("b")
+    assert client.list_buckets() == ["a", "b"]
+    client.delete_bucket("a")
+    assert client.list_buckets() == ["b"]
+
+
+def test_health_check(client, server):
+    assert client.health_check()["status"] == "UP"
+    server.close()
+    assert client.health_check()["status"] == "DOWN"
+
+
+def test_quoted_tag_values_roundtrip(client):
+    client.create_bucket("q")
+    client.write_point("q", "t", 1.0, {"v": 5.0}, {"host": "o'brien"})
+    assert client.query("q", "t", "v", tags={"host": "o'brien"}) == \
+        [(1.0, 5.0)]
+
+
+def test_invalid_identifier_rejected(client):
+    with pytest.raises(TimeseriesError, match="invalid identifier"):
+        client.query("b", 'x" OR 1=1', "v")

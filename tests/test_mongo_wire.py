@@ -1,0 +1,133 @@
+"""Mongo wire client: BSON codec + OP_MSG over a real socket against
+the mini server (reference datasource/mongo's network-client role)."""
+
+import datetime
+
+import pytest
+
+from gofr_tpu.datasource.mongo_wire import (
+    MiniMongoServer,
+    MongoWire,
+    MongoWireError,
+    ObjectId,
+    decode_bson,
+    decode_op_msg,
+    encode_bson,
+    encode_op_msg,
+)
+
+
+# ------------------------------------------------------------------ codec
+
+def test_bson_roundtrip_all_types():
+    oid = ObjectId()
+    doc = {
+        "str": "héllo",
+        "int32": 42,
+        "int64": 1 << 40,
+        "neg": -7,
+        "float": 3.5,
+        "bool_t": True,
+        "bool_f": False,
+        "null": None,
+        "binary": b"\x00\x01\xff",
+        "oid": oid,
+        "when": datetime.datetime(2026, 7, 30, 12, 0,
+                                  tzinfo=datetime.timezone.utc),
+        "nested": {"a": [1, "two", {"three": 3}]},
+    }
+    got, pos = decode_bson(encode_bson(doc))
+    assert pos == len(encode_bson(doc))
+    assert got == doc
+
+
+def test_object_ids_unique_and_stable():
+    a, b = ObjectId(), ObjectId()
+    assert a != b
+    assert len(a.raw) == 12
+    assert ObjectId(a.raw) == a
+    assert str(a) == a.raw.hex()
+
+
+def test_op_msg_roundtrip():
+    frame = encode_op_msg(7, {"ping": 1, "$db": "x"})
+    request_id, response_to, body = decode_op_msg(frame)
+    assert request_id == 7 and response_to == 0
+    assert body == {"ping": 1, "$db": "x"}
+
+
+# ------------------------------------------------------------- end-to-end
+
+@pytest.fixture()
+def server():
+    srv = MiniMongoServer()
+    srv.start()
+    yield srv
+    srv.close()
+
+
+@pytest.fixture()
+def client(server):
+    c = MongoWire(host="127.0.0.1", port=server.port, database="testdb")
+    c.connect()
+    yield c
+    c.close()
+
+
+def test_insert_find_roundtrip(client):
+    oid = client.insert_one("users", {"name": "ada", "age": 36})
+    assert isinstance(oid, ObjectId)
+    rows = client.find("users", {"name": "ada"})
+    assert len(rows) == 1
+    assert rows[0]["age"] == 36
+    assert rows[0]["_id"] == oid
+    assert client.find_one("users", {"name": "nobody"}) is None
+
+
+def test_filters_update_delete_count(client):
+    client.insert_many("n", [{"v": i} for i in range(10)])
+    assert client.count_documents("n") == 10
+    assert len(client.find("n", {"v": {"$gte": 5}})) == 5
+    assert client.update_many("n", {"v": {"$lt": 3}}, {"flag": True}) == 3
+    assert client.count_documents("n", {"flag": True}) == 3
+    assert client.delete_many("n", {"v": {"$gte": 8}}) == 2
+    assert client.count_documents("n") == 8
+    client.drop("n")
+    assert client.count_documents("n") == 0
+
+
+def test_find_by_object_id(client):
+    oid = client.insert_one("docs", {"body": "x"})
+    got = client.find_one("docs", {"_id": oid})
+    assert got is not None and got["body"] == "x"
+
+
+def test_duplicate_id_errors_but_connection_survives(client):
+    oid = client.insert_one("dup", {"a": 1})
+    with pytest.raises(MongoWireError, match="duplicate"):
+        client.command({"insert": "dup",
+                        "documents": [{"_id": oid, "a": 2}]})
+    assert client.count_documents("dup") == 1  # still usable
+
+
+def test_health_check_up_down(server, client):
+    assert client.health_check()["status"] == "UP"
+    server.close()
+    assert client.health_check()["status"] == "DOWN"
+
+
+def test_write_errors_raise(client, monkeypatch):
+    """ok:1 + writeErrors (how real servers report failed writes) must
+    raise, not silently succeed."""
+    real = MiniMongoServer._execute
+
+    def with_write_error(self, body):
+        if "insert" in body:
+            return {"ok": 1.0, "n": 0,
+                    "writeErrors": [{"index": 0, "code": 11000,
+                                     "errmsg": "E11000 duplicate key"}]}
+        return real(self, body)
+
+    monkeypatch.setattr(MiniMongoServer, "_execute", with_write_error)
+    with pytest.raises(MongoWireError, match="duplicate key"):
+        client.insert_one("w", {"a": 1})
