@@ -25,6 +25,7 @@ import urllib.request
 from typing import Any
 
 from . import Instrumented
+from .miniserver import ThreadedHTTPMiniServer
 from .timeseries import SeriesEngine, TimeseriesError
 
 
@@ -237,18 +238,15 @@ class InfluxWire(Instrumented):
 
 # ------------------------------------------------------------ mini server
 
-class MiniInfluxServer:
+class MiniInfluxServer(ThreadedHTTPMiniServer):
     """The 1.x HTTP surface over the embedded SeriesEngine, on the
-    framework's own HTTP server."""
+    framework's own HTTP server (lifecycle from
+    :class:`~gofr_tpu.datasource.miniserver.ThreadedHTTPMiniServer`)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
-        self.host = host
-        self.port = port
+        super().__init__(host, port)
         self.engines: dict[str, SeriesEngine] = {}
         self._lock = threading.Lock()
-        self._server: Any = None
-        self._loop_thread: threading.Thread | None = None
-        self._loop: Any = None
 
     def _engine(self, db: str) -> SeriesEngine:
         with self._lock:
@@ -256,40 +254,13 @@ class MiniInfluxServer:
                 self.engines[db] = SeriesEngine()
             return self.engines[db]
 
-    def start(self) -> None:
-        """Boot the asyncio HTTP server on a daemon thread so sync
-        clients (urllib) can talk to it from the test thread."""
-        import asyncio
-
-        from ..http.responder import ResponseData
-        from ..http.server import HTTPServer
-
-        async def handler(request) -> ResponseData:
-            try:
-                status, payload = self._route(request)
-            except TimeseriesError as exc:
-                status, payload = 400, {"error": str(exc)}
-            body = b"" if payload is None else json.dumps(payload).encode()
-            return ResponseData(status=status, body=body,
-                                content_type="application/json")
-
-        ready = threading.Event()
-
-        def run() -> None:
-            loop = asyncio.new_event_loop()
-            self._loop = loop
-            server = HTTPServer(handler, host=self.host, port=self.port)
-            loop.run_until_complete(server.start())
-            self._server = server
-            self.port = server.bound_port
-            ready.set()
-            loop.run_forever()
-
-        self._loop_thread = threading.Thread(target=run, daemon=True,
-                                             name="mini-influx")
-        self._loop_thread.start()
-        if not ready.wait(10):
-            raise TimeseriesError("mini influx failed to start")
+    def handle(self, request) -> tuple[int, bytes, str]:
+        try:
+            status, payload = self._route(request)
+        except TimeseriesError as exc:
+            status, payload = 400, {"error": str(exc)}
+        body = b"" if payload is None else json.dumps(payload).encode()
+        return status, body, "application/json"
 
     def _route(self, request) -> tuple[int, Any]:
         if request.path == "/ping":
@@ -379,21 +350,3 @@ class MiniInfluxServer:
         return 200, {"results": [{"series": [
             {"name": measurement, "columns": ["time", field],
              "values": [[int(ts * 1e9), v] for ts, v, _ in points]}]}]}
-
-    def close(self) -> None:
-        import asyncio
-        if self._loop is None:
-            return
-
-        async def stop() -> None:
-            if self._server is not None:
-                await self._server.shutdown()
-
-        try:
-            asyncio.run_coroutine_threadsafe(stop(), self._loop) \
-                .result(timeout=5)
-        except Exception:
-            pass
-        self._loop.call_soon_threadsafe(self._loop.stop)
-        if self._loop_thread is not None:
-            self._loop_thread.join(timeout=5)

@@ -49,6 +49,9 @@ def tracer_middleware(tracer) -> Middleware:
                 span.set_attribute("http.status", response.status)
                 if response.status >= 500:
                     span.set_status(f"ERROR: {response.status}")
+                # clients correlate support tickets to traces by this
+                # header — on every status, errors especially
+                response.headers.setdefault("X-Trace-Id", span.trace_id)
                 return response
             finally:
                 span.end()

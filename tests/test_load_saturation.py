@@ -81,15 +81,16 @@ def test_64_concurrent_chats_saturate_and_complete():
         if line.startswith("app_chat_ttft_seconds_count"))
     assert int(float(ttft_count.split()[-1])) == N_REQUESTS
 
-    # 3) fairness: with 8 slots serving 64 requests the last-admitted
-    # request waits ~8 generation rounds; anything far beyond that
-    # means admission starved someone. Bound: slowest TTFT within 16x
-    # the per-round time (generous — catches starvation, not jitter).
+    # 3) fairness: with FIFO admission the TTFT distribution is a
+    # staircase — the slowest request waits its queue turn, nothing
+    # more. Anchor the bound to the MEDIAN (robust to a loaded CI
+    # machine; anchoring to the fastest request flakes under
+    # contention): a starved request would sit orders of magnitude
+    # beyond the pack.
     ttfts = sorted(r["usage"]["ttft_ms"] for r in results)
-    per_round = max(ttfts[0], 1.0)
-    rounds = N_REQUESTS / 8
-    assert ttfts[-1] <= per_round * rounds * 16 + 5_000, (
-        f"slowest TTFT {ttfts[-1]:.0f}ms vs first {ttfts[0]:.0f}ms")
+    median = max(ttfts[len(ttfts) // 2], 1.0)
+    assert ttfts[-1] <= max(median * 25, 10_000), (
+        f"slowest TTFT {ttfts[-1]:.0f}ms vs median {median:.0f}ms")
 
     # sanity: saturated throughput is positive and finite
     assert wall < 180
