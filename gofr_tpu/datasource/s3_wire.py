@@ -52,6 +52,13 @@ def _uri_encode(s: str, *, slash_ok: bool = False) -> str:
     return urllib.parse.quote(s, safe=safe)
 
 
+def _canonical_query(query: dict[str, str]) -> str:
+    """The one encoding both the signature and the URL must share —
+    a single construction site so they byte-match by construction."""
+    return "&".join(f"{_uri_encode(k)}={_uri_encode(v)}"
+                    for k, v in sorted(query.items()))
+
+
 def sign_v4(method: str, path: str, query: dict[str, str],
             headers: dict[str, str], payload: bytes, *,
             access_key: str, secret_key: str, region: str,
@@ -71,9 +78,7 @@ def sign_v4(method: str, path: str, query: dict[str, str],
     signed_names = sorted(out)
     canonical_headers = "".join(f"{k}:{out[k]}\n" for k in signed_names)
     signed_headers = ";".join(signed_names)
-    canonical_query = "&".join(
-        f"{_uri_encode(k)}={_uri_encode(v)}"
-        for k, v in sorted(query.items()))
+    canonical_query = _canonical_query(query)
     canonical_request = "\n".join([
         method, _uri_encode(path, slash_ok=True), canonical_query,
         canonical_headers, signed_headers, payload_hash])
@@ -136,10 +141,7 @@ class S3Wire(Instrumented):
                           secret_key=self.secret_key, region=self.region)
         url = self.endpoint + _uri_encode(path, slash_ok=True)
         if query:
-            # the URL query encoding must byte-match the canonical
-            # query the signature covers
-            url += "?" + "&".join(f"{_uri_encode(k)}={_uri_encode(v)}"
-                                  for k, v in sorted(query.items()))
+            url += "?" + _canonical_query(query)
         req = urllib.request.Request(url, data=body or None, method=method,
                                      headers=headers)
         try:
@@ -151,9 +153,16 @@ class S3Wire(Instrumented):
     # ----------------------------------------------------- native verbs
     def create_bucket(self, bucket: str | None = None) -> None:
         name = bucket or self.bucket
+        # AWS requires a LocationConstraint body outside us-east-1
+        body = b""
+        if self.region != "us-east-1":
+            body = (
+                "<CreateBucketConfiguration>"
+                f"<LocationConstraint>{self.region}</LocationConstraint>"
+                "</CreateBucketConfiguration>").encode()
 
         def op():
-            status, data = self._call("PUT", f"/{name}")
+            status, data = self._call("PUT", f"/{name}", body=body)
             if status not in (200, 409):
                 raise S3Error(f"create bucket -> {status}: {data[:200]!r}")
         self._observed("CREATE_BUCKET", name, op)
@@ -185,29 +194,44 @@ class S3Wire(Instrumented):
 
     def list_objects(self, prefix: str = "") -> list[dict]:
         def op():
-            status, data = self._call(
-                "GET", f"/{self.bucket}",
-                query={"list-type": "2", "prefix": prefix})
-            if status != 200:
-                raise S3Error(f"list -> {status}: {data[:200]!r}")
-            root = ET.fromstring(data)
-            ns = root.tag.partition("}")[0] + "}" if "}" in root.tag else ""
-            out = []
-            # same dict shape as the embedded S3FileSystem.list_objects
-            # (object_store.py) so backend swaps never break callers
-            for item in root.iter(f"{ns}Contents"):
-                out.append({
-                    "Key": item.findtext(f"{ns}Key", ""),
-                    "Size": int(item.findtext(f"{ns}Size", "0")),
-                    "LastModified": item.findtext(
-                        f"{ns}LastModified", "")})
-            return out
+            out: list[dict] = []
+            token = ""
+            while True:  # follow ListObjectsV2 pagination to the end
+                query = {"list-type": "2", "prefix": prefix}
+                if token:
+                    query["continuation-token"] = token
+                status, data = self._call("GET", f"/{self.bucket}",
+                                          query=query)
+                if status != 200:
+                    raise S3Error(f"list -> {status}: {data[:200]!r}")
+                root = ET.fromstring(data)
+                ns = (root.tag.partition("}")[0] + "}"
+                      if "}" in root.tag else "")
+                # same dict shape as the embedded
+                # S3FileSystem.list_objects (object_store.py) so
+                # backend swaps never break callers
+                for item in root.iter(f"{ns}Contents"):
+                    out.append({
+                        "Key": item.findtext(f"{ns}Key", ""),
+                        "Size": int(item.findtext(f"{ns}Size", "0")),
+                        "LastModified": item.findtext(
+                            f"{ns}LastModified", "")})
+                if root.findtext(f"{ns}IsTruncated", "false") != "true":
+                    return out
+                token = root.findtext(f"{ns}NextContinuationToken", "")
+                if not token:
+                    return out
         return self._observed("LIST", prefix or "*", op)
 
     def exists(self, key: str) -> bool:
         def op():
-            status, _ = self._call("HEAD", f"/{self.bucket}/{key}")
-            return status == 200
+            status, data = self._call("HEAD", f"/{self.bucket}/{key}")
+            if status == 200:
+                return True
+            if status == 404:
+                return False
+            # 403/5xx are auth or server trouble, not "object absent"
+            raise S3Error(f"head {key} -> {status}: {data[:200]!r}")
         return self._observed("HEAD", key, op)
 
     def health_check(self) -> dict[str, Any]:
@@ -314,9 +338,15 @@ class MiniS3Server(ThreadedHTTPMiniServer):
 
     def _list(self, bucket: str, request) -> tuple[int, bytes, str]:
         prefix = request.param("prefix")
+        max_keys = int(request.param("max-keys") or "1000")
+        token = request.param("continuation-token")
+        rows = sorted(self.engine.list(bucket, prefix))
+        if token:  # opaque token = last key of the previous page
+            rows = [r for r in rows if r[0] > token]
+        page, rest = rows[:max_keys], rows[max_keys:]
         root = ET.Element("ListBucketResult")
         ET.SubElement(root, "Name").text = bucket
-        for key, size, mtime in self.engine.list(bucket, prefix):
+        for key, size, mtime in page:
             item = ET.SubElement(root, "Contents")
             ET.SubElement(item, "Key").text = key
             ET.SubElement(item, "Size").text = str(size)
@@ -324,4 +354,8 @@ class MiniS3Server(ThreadedHTTPMiniServer):
                 _dt.datetime.fromtimestamp(
                     mtime, tz=_dt.timezone.utc).strftime(
                         "%Y-%m-%dT%H:%M:%S.000Z")
+        ET.SubElement(root, "IsTruncated").text = \
+            "true" if rest else "false"
+        if rest and page:
+            ET.SubElement(root, "NextContinuationToken").text = page[-1][0]
         return 200, ET.tostring(root), "application/xml"

@@ -13,7 +13,7 @@ from .errors import (
 )
 from .request import HTTPRequest
 from .responder import Responder
-from .response import File, Partial, Raw, Redirect, Response, Template
+from .response import File, Partial, Raw, Redirect, Response, Template, XML
 from .router import Route, Router
 
 __all__ = [
@@ -22,5 +22,5 @@ __all__ = [
     "ErrorMethodNotAllowed", "ErrorPanicRecovery", "ErrorRequestTimeout",
     "ErrorServiceUnavailable", "HTTPError",
     "HTTPRequest", "Responder", "File", "Partial", "Raw", "Redirect",
-    "Response", "Template", "Route", "Router",
+    "Response", "Template", "XML", "Route", "Router",
 ]

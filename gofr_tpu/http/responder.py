@@ -16,7 +16,8 @@ from dataclasses import dataclass, field
 from typing import Any, AsyncIterator
 
 from .errors import status_and_level_for
-from .response import File, Partial, Raw, Redirect, Response, Stream, Template
+from .response import (File, Partial, Raw, Redirect, Response, Stream,
+                       Template, XML)
 
 
 @dataclass
@@ -72,6 +73,11 @@ class Responder:
         if isinstance(result, Template):
             return ResponseData(status=200, body=result.render().encode(),
                                 content_type="text/html; charset=utf-8")
+
+        if isinstance(result, XML):
+            status = {"POST": 201}.get(method, 200)
+            return ResponseData(status=status, body=result.render().encode(),
+                                content_type="application/xml; charset=utf-8")
 
         if isinstance(result, Raw):
             status = {"POST": 201}.get(method, 200)

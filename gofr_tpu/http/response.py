@@ -69,6 +69,55 @@ class Template:
 
 
 @dataclass
+class XML:
+    """Marshal ``data`` as an XML document (reference response/xml.go).
+
+    Dicts become child elements, lists repeat the ``item`` element, and
+    scalars become text nodes; attribute-free by design — handlers that
+    need full control return :class:`Raw` bytes with an XML content
+    type instead.
+    """
+
+    data: Any
+    root: str = "response"
+
+    def render(self) -> str:
+        return ('<?xml version="1.0" encoding="UTF-8"?>'
+                f"{_xml_element(self.root, self.data)}")
+
+
+def _xml_escape(text: str) -> str:
+    return (text.replace("&", "&amp;").replace("<", "&lt;")
+            .replace(">", "&gt;"))
+
+
+def _xml_tag(name: str) -> str:
+    """Sanitize a data-driven key into a well-formed element name.
+
+    Keys can come from user payloads a handler echoes back; passing
+    them through raw would let ``"k></x><admin>"`` inject elements.
+    """
+    import re
+    tag = re.sub(r"[^A-Za-z0-9_.-]", "_", str(name)) or "_"
+    if not (tag[0].isalpha() or tag[0] == "_"):
+        tag = "_" + tag
+    return tag
+
+
+def _xml_element(tag: str, value: Any) -> str:
+    tag = _xml_tag(tag)
+    if isinstance(value, dict):
+        inner = "".join(_xml_element(str(k), v) for k, v in value.items())
+    elif isinstance(value, (list, tuple)):
+        inner = "".join(_xml_element("item", v) for v in value)
+    elif value is None:
+        inner = ""
+    else:
+        inner = _xml_escape(str(value))
+    return f"<{tag}>{inner}</{tag}>"
+
+
+@dataclass
 class Partial:
     """Data AND error together -> 206 Partial Content."""
 

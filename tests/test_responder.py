@@ -78,6 +78,18 @@ def test_response_with_metadata_and_headers():
     assert resp.headers["X-Custom"] == "v"
 
 
+def test_xml_response():
+    from gofr_tpu.http import XML
+    resp = r.respond(XML({"name": "a<b", "tags": ["x", "y"]}, root="doc"),
+                     None, "GET")
+    assert resp.status == 200
+    assert resp.content_type.startswith("application/xml")
+    assert resp.body == (b'<?xml version="1.0" encoding="UTF-8"?>'
+                         b"<doc><name>a&lt;b</name>"
+                         b"<tags><item>x</item><item>y</item></tags></doc>")
+    assert r.respond(XML({}), None, "POST").status == 201
+
+
 def test_custom_error_status_code_attr():
     class TeapotError(Exception):
         status_code = 418

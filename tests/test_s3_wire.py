@@ -46,6 +46,40 @@ def test_list_objects_with_prefix(client):
     assert {o["Size"] for o in under_a} == {1, 2}
 
 
+def test_list_objects_follows_pagination(server, client):
+    """The client must walk IsTruncated/NextContinuationToken to the
+    end — real S3 truncates at max-keys (default 1000)."""
+    for i in range(7):
+        client.put_object(f"p/{i:02d}", b"v")
+    pages = []
+    orig = client._call
+
+    def spy(method, path, query=None, body=b""):
+        if query and query.get("list-type") == "2":
+            # shrink the page size so truncation actually happens
+            query = dict(query, **{"max-keys": "3"})
+            pages.append(query.get("continuation-token", ""))
+        return orig(method, path, query, body)
+
+    client._call = spy
+    try:
+        keys = [o["Key"] for o in client.list_objects(prefix="p/")]
+    finally:
+        client._call = orig
+    assert keys == [f"p/{i:02d}" for i in range(7)]
+    assert len(pages) == 3  # 3+3+1 across three requests
+
+
+def test_exists_true_false_and_error(server, client):
+    client.put_object("here", b"x")
+    assert client.exists("here") is True
+    assert client.exists("absent") is False
+    bad = S3Wire(endpoint=f"127.0.0.1:{server.port}", bucket="data",
+                 access_key="AKID", secret_key="WRONG")
+    with pytest.raises(S3Error, match="403"):
+        bad.exists("here")  # auth trouble must not read as "absent"
+
+
 def test_wrong_secret_is_rejected(server):
     bad = S3Wire(endpoint=f"127.0.0.1:{server.port}", bucket="data",
                  access_key="AKID", secret_key="WRONG")
