@@ -6,12 +6,13 @@ structured ``QueryLog`` and an ``app_sql_stats`` histogram sample
 (db.go:47-60), plus an ORM-lite ``select`` that maps rows into
 dataclasses (db.go:214) and a transaction wrapper (db.go:124).
 
-Backends: sqlite (stdlib, always available). The mysql/postgres/
-cockroach/supabase dialects from the reference (sql.go:22-35) are
-accepted for query-building (placeholder style, AUTOINCREMENT spelling)
-so the query builder and auto-CRUD work identically, but connecting to
-them requires a driver this image doesn't ship — ``connect`` raises a
-clear error for those.
+Backends: sqlite (stdlib, always available) and network postgres-family
+servers via :class:`~gofr_tpu.datasource.postgres_wire.PostgresWire`
+(the v3 wire protocol, selected by ``DB_DIALECT=postgres`` +
+``DB_HOST``). The mysql dialect is accepted for query-building
+(placeholder style, AUTOINCREMENT spelling) so the query builder and
+auto-CRUD work identically, but connecting requires a driver this
+image doesn't ship — ``connect`` raises a clear error for it.
 """
 
 from __future__ import annotations
@@ -270,6 +271,28 @@ def new_sql(config: Any, logger: Any = None, metrics: Any = None,
     dialect = config.get("DB_DIALECT") if config else None
     if not dialect:
         return None
+    host = config.get("DB_HOST")
+    if dialect in _DOLLAR_PLACEHOLDER and host:
+        # a network postgres-family server: dial it over the v3 wire
+        # protocol (reference sql.go:74 does this via lib/pq)
+        from .postgres_wire import PostgresWire
+        db = PostgresWire(
+            host=host,
+            port=int(config.get_or_default("DB_PORT", "5432")),
+            user=config.get_or_default("DB_USER", "postgres"),
+            password=config.get_or_default("DB_PASSWORD", ""),
+            database=config.get_or_default("DB_NAME", "postgres"))
+        for use, obj in (("use_logger", logger), ("use_metrics", metrics),
+                         ("use_tracer", tracer)):
+            if obj is not None:
+                getattr(db, use)(obj)
+        try:
+            db.connect()
+        except Exception as exc:
+            if logger is not None:
+                logger.error(f"SQL connect failed: {exc}")
+            return None
+        return db
     try:
         db = SQL(dialect=dialect,
                  database=config.get_or_default("DB_NAME", ":memory:"))

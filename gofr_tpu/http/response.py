@@ -9,9 +9,11 @@ plus ``Partial`` for the data+error -> 206 policy
 from __future__ import annotations
 
 import mimetypes
+import re
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any
+from xml.sax.saxutils import escape as _xml_escape
 
 
 @dataclass
@@ -86,9 +88,7 @@ class XML:
                 f"{_xml_element(self.root, self.data)}")
 
 
-def _xml_escape(text: str) -> str:
-    return (text.replace("&", "&amp;").replace("<", "&lt;")
-            .replace(">", "&gt;"))
+_XML_TAG_BAD = re.compile(r"[^A-Za-z0-9_.-]")
 
 
 def _xml_tag(name: str) -> str:
@@ -97,8 +97,7 @@ def _xml_tag(name: str) -> str:
     Keys can come from user payloads a handler echoes back; passing
     them through raw would let ``"k></x><admin>"`` inject elements.
     """
-    import re
-    tag = re.sub(r"[^A-Za-z0-9_.-]", "_", str(name)) or "_"
+    tag = _XML_TAG_BAD.sub("_", str(name)) or "_"
     if not (tag[0].isalpha() or tag[0] == "_"):
         tag = "_" + tag
     return tag

@@ -1,0 +1,231 @@
+"""PostgreSQL v3 wire protocol: client against the mini server.
+
+Real protocol bytes over a real TCP socket — startup, MD5 and
+SCRAM-SHA-256 auth exchanges verified for real, simple + extended
+query cycles, transactions, and error recovery.
+"""
+
+from dataclasses import dataclass
+
+import pytest
+
+from gofr_tpu.datasource.postgres_wire import (
+    MiniPostgresServer, PostgresError, PostgresWire)
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = MiniPostgresServer(user="app", password="s3cr3t", auth="md5")
+    srv.start()
+    yield srv
+    srv.close()
+
+
+@pytest.fixture()
+def db(server):
+    c = PostgresWire(host="127.0.0.1", port=server.port,
+                     user="app", password="s3cr3t", database="appdb")
+    c.connect()
+    yield c
+    c.close()
+
+
+def test_startup_and_parameter_status(db):
+    assert db.server_params["server_version"].startswith("16")
+
+
+def test_simple_query_roundtrip(db):
+    db.exec("CREATE TABLE IF NOT EXISTS t_simple (id INTEGER, name TEXT)")
+    db.exec("DELETE FROM t_simple")
+    db.exec("INSERT INTO t_simple VALUES (1, 'ada'), (2, 'grace')")
+    rows = db.query("SELECT id, name FROM t_simple ORDER BY id")
+    assert [(r["id"], r["name"]) for r in rows] == [(1, "ada"), (2, "grace")]
+    assert db.query_row("SELECT name FROM t_simple WHERE id = 2")["name"] \
+        == "grace"
+
+
+def test_extended_query_with_dollar_params(db):
+    db.exec("CREATE TABLE IF NOT EXISTS t_ext "
+            "(id INTEGER, score REAL, blob BLOB, note TEXT)")
+    db.exec("DELETE FROM t_ext")
+    res = db.exec("INSERT INTO t_ext VALUES ($1, $2, $3, $4)",
+                  7, 2.5, b"\x00\xff", "hi there")
+    assert res.rowcount == 1
+    row = db.query_row("SELECT * FROM t_ext WHERE id = $1", 7)
+    assert row["score"] == 2.5
+    assert row["blob"] == b"\x00\xff"
+    assert row["note"] == "hi there"
+    # NULL params travel as -1 length
+    db.exec("INSERT INTO t_ext VALUES ($1, $2, $3, $4)", 8, None, None, None)
+    row = db.query_row("SELECT score, note FROM t_ext WHERE id = $1", 8)
+    assert row["score"] is None and row["note"] is None
+
+
+def test_param_reuse_order(db):
+    """$N placeholders bind by number, not appearance order."""
+    row = db.query_row("SELECT $2 AS a, $1 AS b, $2 AS c", 10, 20)
+    assert (row["a"], row["b"], row["c"]) == (20, 10, 20)
+
+
+def test_exec_rowcount_tags(db):
+    db.exec("CREATE TABLE IF NOT EXISTS t_tags (id INTEGER)")
+    db.exec("DELETE FROM t_tags")
+    assert db.exec("INSERT INTO t_tags VALUES (1), (2), (3)").rowcount == 3
+    assert db.exec("UPDATE t_tags SET id = id + 10").rowcount == 3
+    assert db.exec("DELETE FROM t_tags WHERE id > 11").rowcount == 2
+
+
+def test_transaction_commit_and_rollback(db):
+    db.exec("CREATE TABLE IF NOT EXISTS t_tx (id INTEGER)")
+    db.exec("DELETE FROM t_tx")
+    with db.begin() as tx:
+        tx.exec("INSERT INTO t_tx VALUES ($1)", 1)
+    assert len(db.query("SELECT * FROM t_tx")) == 1
+    with pytest.raises(RuntimeError):
+        with db.begin() as tx:
+            tx.exec("INSERT INTO t_tx VALUES ($1)", 2)
+            raise RuntimeError("boom")
+    assert len(db.query("SELECT * FROM t_tx")) == 1  # rolled back
+
+
+def test_error_response_and_recovery(db):
+    with pytest.raises(PostgresError) as exc:
+        db.query("SELECT * FROM no_such_table")
+    assert exc.value.sqlstate
+    # the connection survives an error cycle
+    assert db.query_row("SELECT 1 AS one")["one"] == 1
+    # extended-cycle error also recovers (server skips to Sync)
+    with pytest.raises(PostgresError):
+        db.query("SELECT * FROM no_such_table WHERE id = $1", 1)
+    assert db.query_row("SELECT 2 AS two")["two"] == 2
+
+
+def test_select_orm_lite(db):
+    @dataclass
+    class Person:
+        id: int
+        name: str
+
+    db.exec("CREATE TABLE IF NOT EXISTS people (id INTEGER, name TEXT)")
+    db.exec("DELETE FROM people")
+    db.exec("INSERT INTO people VALUES ($1, $2)", 1, "ada")
+    people = db.select(Person, "SELECT id, name FROM people")
+    assert people == [Person(1, "ada")]
+
+
+def test_md5_wrong_password_rejected(server):
+    bad = PostgresWire(host="127.0.0.1", port=server.port,
+                       user="app", password="WRONG")
+    with pytest.raises(PostgresError, match="authentication"):
+        bad.connect()
+
+
+def test_unknown_user_rejected(server):
+    bad = PostgresWire(host="127.0.0.1", port=server.port,
+                       user="nobody", password="s3cr3t")
+    with pytest.raises(PostgresError):
+        bad.connect()
+
+
+def test_cleartext_auth():
+    srv = MiniPostgresServer(user="u", password="pw", auth="password")
+    srv.start()
+    try:
+        c = PostgresWire(host="127.0.0.1", port=srv.port,
+                         user="u", password="pw")
+        c.connect()
+        assert c.query_row("SELECT 1 AS x")["x"] == 1
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_scram_sha256_auth_and_mutual_verification():
+    srv = MiniPostgresServer(user="u", password="pw", auth="scram-sha-256")
+    srv.start()
+    try:
+        c = PostgresWire(host="127.0.0.1", port=srv.port,
+                         user="u", password="pw")
+        c.connect()  # raises if the server's signature fails to verify
+        assert c.query_row("SELECT 42 AS v")["v"] == 42
+        c.close()
+        bad = PostgresWire(host="127.0.0.1", port=srv.port,
+                           user="u", password="nope")
+        with pytest.raises(PostgresError, match="authentication"):
+            bad.connect()
+    finally:
+        srv.close()
+
+
+def test_env_driven_container_swap(server):
+    """DB_DIALECT=postgres + DB_HOST dials the wire client through the
+    same new_sql entry the container uses (reference sql.go:74)."""
+    from gofr_tpu.config.env import DictConfig
+    from gofr_tpu.datasource.sql import new_sql
+
+    cfg = DictConfig({"DB_DIALECT": "postgres",
+                     "DB_HOST": "127.0.0.1",
+                     "DB_PORT": str(server.port),
+                     "DB_USER": "app", "DB_PASSWORD": "s3cr3t",
+                     "DB_NAME": "appdb"})
+    db = new_sql(cfg)
+    assert isinstance(db, PostgresWire)
+    assert db.query_row("SELECT 5 AS five")["five"] == 5
+    assert db.health_check()["status"] == "UP"
+    db.close()
+
+
+def test_dollar_inside_string_literal_is_not_a_param(db):
+    db.exec("CREATE TABLE IF NOT EXISTS t_lit (note TEXT)")
+    db.exec("DELETE FROM t_lit")
+    db.exec("INSERT INTO t_lit VALUES ('costs $15')")
+    assert db.query_row("SELECT note FROM t_lit")["note"] == "costs $15"
+
+
+def test_null_in_first_row_keeps_numeric_oid(db):
+    db.exec("CREATE TABLE IF NOT EXISTS t_null (score REAL)")
+    db.exec("DELETE FROM t_null")
+    db.exec("INSERT INTO t_null VALUES (NULL), (2.5)")
+    rows = db.query("SELECT score FROM t_null ORDER BY score")
+    assert rows[0]["score"] is None
+    assert rows[1]["score"] == 2.5  # float, not the string "2.5"
+
+
+def test_transactions_are_per_connection(server):
+    """Client A's open BEGIN must not swallow client B's insert —
+    postgres transactions are per-connection."""
+    a = PostgresWire(host="127.0.0.1", port=server.port,
+                     user="app", password="s3cr3t")
+    b = PostgresWire(host="127.0.0.1", port=server.port,
+                     user="app", password="s3cr3t")
+    a.connect()
+    b.connect()
+    try:
+        a.exec("CREATE TABLE IF NOT EXISTS t_iso (id INTEGER)")
+        a.exec("DELETE FROM t_iso")
+        a.exec("BEGIN")
+        a.exec("INSERT INTO t_iso VALUES (1)")
+        import threading
+        done = threading.Event()
+
+        def other():
+            b.exec("INSERT INTO t_iso VALUES (2)")  # blocks until A ends
+            done.set()
+
+        t = threading.Thread(target=other, daemon=True)
+        t.start()
+        a.exec("ROLLBACK")  # A's insert is discarded...
+        assert done.wait(10)
+        t.join(10)
+        rows = a.query("SELECT id FROM t_iso")
+        # ...while B's, committed after A released, survives
+        assert [r["id"] for r in rows] == [2]
+    finally:
+        a.close()
+        b.close()
+
+
+def test_health_check(db):
+    assert db.health_check()["status"] == "UP"
+    loose = PostgresWire(host="127.0.0.1", port=1, user="x")
+    assert loose.health_check()["status"] == "DOWN"
