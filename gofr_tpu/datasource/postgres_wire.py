@@ -337,14 +337,26 @@ class PostgresWire(ProviderMixin):
             # 'S' ParameterStatus, 'N' NoticeResponse: skip
 
     # --------------------------------------------------- public surface
+    def _cycle(self, query: str, args: tuple) -> tuple[list[PGRow], str]:
+        """One query cycle; a mid-cycle I/O failure poisons the stream
+        (unconsumed response bytes would pair with the NEXT request),
+        so the connection is torn down rather than kept."""
+        try:
+            return (self._extended_query(query, args) if args
+                    else self._simple_query(query))
+        except (OSError, TimeoutError) as exc:
+            self.close()
+            raise PostgresError(
+                f"connection lost mid-query ({exc}); reconnect required"
+            ) from exc
+
     def query(self, query: str, *args: Any) -> list[PGRow]:
         start = time.perf_counter()
         span = (self.tracer.start_span(f"sql {query.split(None, 1)[0]}")
                 if self.tracer is not None else None)
         try:
             with self._lock:
-                rows, _ = (self._extended_query(query, args) if args
-                           else self._simple_query(query))
+                rows, _ = self._cycle(query, args)
                 return rows
         finally:
             if span is not None:
@@ -361,8 +373,7 @@ class PostgresWire(ProviderMixin):
                 if self.tracer is not None else None)
         try:
             with self._lock:
-                _, tag = (self._extended_query(query, args) if args
-                          else self._simple_query(query))
+                _, tag = self._cycle(query, args)
                 return PGResult(tag)
         finally:
             if span is not None:
@@ -373,12 +384,13 @@ class PostgresWire(ProviderMixin):
     def begin(self) -> Iterator["PostgresWire"]:
         """BEGIN/COMMIT with rollback-on-raise, mirroring SQL.begin."""
         with self._lock:
-            self._simple_query("BEGIN")
+            self._cycle("BEGIN", ())
             try:
                 yield self
-                self._simple_query("COMMIT")
+                self._cycle("COMMIT", ())
             except BaseException:
-                self._simple_query("ROLLBACK")
+                if self._sock is not None:  # skip if the link just died
+                    self._cycle("ROLLBACK", ())
                 raise
 
     def select(self, entity_type: type, query: str, *args: Any) -> list[Any]:
@@ -837,6 +849,11 @@ class MiniPostgresServer:
                 query: str,
                 params: list[Any]) -> tuple[list[tuple], list[str], str]:
         qmark, order = _dollar_to_qmark(query)
+        bad = [i for i in order if not 1 <= i <= len(params)]
+        if bad:
+            # surfaces as an ErrorResponse, not a torn connection
+            raise sqlite3.OperationalError(
+                f"there is no parameter ${bad[0]}")
         args = [params[i - 1] for i in order] if order else params
         word = query.split(None, 1)[0].upper() if query.split() else ""
         if word == "BEGIN" and not state.in_tx:

@@ -276,9 +276,15 @@ def new_sql(config: Any, logger: Any = None, metrics: Any = None,
         # a network postgres-family server: dial it over the v3 wire
         # protocol (reference sql.go:74 does this via lib/pq)
         from .postgres_wire import PostgresWire
+        try:
+            port = int(config.get_or_default("DB_PORT", "5432").strip())
+        except ValueError:
+            if logger is not None:
+                logger.error("SQL disabled: DB_PORT is not an integer")
+            return None
         db = PostgresWire(
             host=host,
-            port=int(config.get_or_default("DB_PORT", "5432")),
+            port=port,
             user=config.get_or_default("DB_USER", "postgres"),
             password=config.get_or_default("DB_PASSWORD", ""),
             database=config.get_or_default("DB_NAME", "postgres"))

@@ -1,0 +1,76 @@
+"""ArangoDB HTTP wire client against the mini server."""
+
+import pytest
+
+from gofr_tpu.datasource.arango_wire import (ArangoWire, ArangoWireError,
+                                             MiniArangoServer)
+from gofr_tpu.datasource.graph import NodeNotFound
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = MiniArangoServer(username="root", password="pw")
+    srv.start()
+    yield srv
+    srv.close()
+
+
+@pytest.fixture()
+def db(server):
+    client = ArangoWire(endpoint=f"127.0.0.1:{server.port}",
+                        username="root", password="pw")
+    client.connect()
+    return client
+
+
+def test_document_crud(db):
+    key = db.create_document("people", {"name": "ada", "age": 36})
+    assert key
+    doc = db.get_document("people", key)
+    assert doc == {"name": "ada", "age": 36}
+    db.update_document("people", key, {"name": "ada", "age": 37})
+    assert db.get_document("people", key)["age"] == 37
+    db.delete_document("people", key)
+    with pytest.raises(NodeNotFound):
+        db.get_document("people", key)
+    with pytest.raises(NodeNotFound):
+        db.delete_document("people", key)
+
+
+def test_query_by_example(db):
+    db.create_document("cities", {"name": "pisa", "country": "it"})
+    db.create_document("cities", {"name": "rome", "country": "it"})
+    db.create_document("cities", {"name": "lyon", "country": "fr"})
+    rows = db.query("cities", {"country": "it"})
+    assert {r["name"] for r in rows} == {"pisa", "rome"}
+    assert all("_id" in r for r in rows)
+    assert len(db.query("cities")) == 3
+
+
+def test_edges_and_traversal(db):
+    a = db.create_document("nodes", {"label": "a"})
+    b = db.create_document("nodes", {"label": "b"})
+    c = db.create_document("nodes", {"label": "c"})
+    db.create_edge_document("links", f"nodes/{a}", f"nodes/{b}")
+    db.create_edge_document("links", b, c)  # bare keys also accepted
+    # traversal lists visited neighbors, excluding the start vertex
+    one_hop = db.traversal(a, "links", depth=1)
+    assert [d["label"] for d in one_hop] == ["b"]
+    two_hops = db.traversal(a, "links", depth=2)
+    assert [d["label"] for d in two_hops] == ["b", "c"]
+
+
+def test_bad_credentials_are_401(server):
+    bad = ArangoWire(endpoint=f"127.0.0.1:{server.port}",
+                     username="root", password="WRONG")
+    with pytest.raises(ArangoWireError, match="401"):
+        bad.create_document("x", {})
+    assert bad.health_check()["status"] == "DOWN"
+
+
+def test_health(db):
+    health = db.health_check()
+    assert health["status"] == "UP"
+    assert health["details"]["version"].startswith("3.11")
+    assert ArangoWire(endpoint="127.0.0.1:1").health_check()["status"] \
+        == "DOWN"

@@ -1,0 +1,131 @@
+"""ClickHouse HTTP-interface and OpenTSDB REST wire clients against
+their mini servers."""
+
+import pytest
+
+from gofr_tpu.datasource.clickhouse_wire import (
+    ClickhouseWire, ClickhouseWireError, MiniClickhouseServer,
+    expand_placeholders)
+from gofr_tpu.datasource.opentsdb_wire import (
+    MiniOpenTSDBServer, OpenTSDBWire, OpenTSDBWireError)
+
+
+@pytest.fixture(scope="module")
+def ch():
+    srv = MiniClickhouseServer()
+    srv.start()
+    client = ClickhouseWire(endpoint=f"127.0.0.1:{srv.port}")
+    client.connect()
+    yield client
+    srv.close()
+
+
+@pytest.fixture(scope="module")
+def tsdb():
+    srv = MiniOpenTSDBServer()
+    srv.start()
+    client = OpenTSDBWire(endpoint=f"127.0.0.1:{srv.port}")
+    client.connect()
+    yield client
+    srv.close()
+
+
+# ------------------------------------------------------------ clickhouse
+
+def test_ch_roundtrip_jsoneachrow(ch):
+    ch.exec("CREATE TABLE events (id INTEGER, kind TEXT, val REAL)")
+    ch.exec("INSERT INTO events VALUES (?, ?, ?)", 1, "click", 0.5)
+    ch.async_insert("INSERT INTO events VALUES (?, ?, ?)", 2, "view", 1.5)
+    rows = ch.select("SELECT id, kind, val FROM events ORDER BY id")
+    assert rows == [{"id": 1, "kind": "click", "val": 0.5},
+                    {"id": 2, "kind": "view", "val": 1.5}]
+
+
+def test_ch_placeholder_escaping(ch):
+    ch.exec("CREATE TABLE quotes (s TEXT)")
+    tricky = "O'Brien said \\ 'hi'"
+    ch.exec("INSERT INTO quotes VALUES (?)", tricky)
+    assert ch.select("SELECT s FROM quotes")[0]["s"] == tricky
+
+
+def test_ch_placeholder_inside_literal_not_expanded():
+    assert expand_placeholders("SELECT 'a?b', ?", (1,)) \
+        == "SELECT 'a?b', 1"
+    with pytest.raises(ClickhouseWireError):
+        expand_placeholders("SELECT ?", ())
+    with pytest.raises(ClickhouseWireError):
+        expand_placeholders("SELECT 1", (5,))
+
+
+def test_ch_null_and_bool_literals(ch):
+    ch.exec("CREATE TABLE flags (a INTEGER, b INTEGER)")
+    ch.exec("INSERT INTO flags VALUES (?, ?)", None, True)
+    row = ch.select("SELECT a, b FROM flags")[0]
+    assert row["a"] is None and row["b"] == 1
+
+
+def test_ch_format_word_in_identifier_still_gets_json(ch):
+    ch.exec("CREATE TABLE fmt (format_version INTEGER)")
+    ch.exec("INSERT INTO fmt VALUES (?)", 3)
+    # 'format' inside an identifier must not suppress the FORMAT clause
+    assert ch.select("SELECT format_version FROM fmt") \
+        == [{"format_version": 3}]
+
+
+def test_ch_error_surfaces(ch):
+    with pytest.raises(ClickhouseWireError, match="DB::Exception"):
+        ch.select("SELECT * FROM nonexistent_table")
+
+
+def test_ch_health(ch):
+    assert ch.health_check()["status"] == "UP"
+    assert ClickhouseWire(endpoint="127.0.0.1:1").health_check()["status"] \
+        == "DOWN"
+
+
+# ------------------------------------------------------------- opentsdb
+
+def test_tsdb_put_and_query(tsdb):
+    n = tsdb.put_data_points([
+        {"metric": "sys.cpu", "timestamp": 100, "value": 1.0,
+         "tags": {"host": "a"}},
+        {"metric": "sys.cpu", "timestamp": 160, "value": 3.0,
+         "tags": {"host": "b"}},
+    ])
+    assert n == 2
+    result = tsdb.query("sys.cpu", aggregator="sum")
+    assert result["dps"] == {"100": 1.0, "160": 3.0}
+    assert result["value"] == 4.0
+
+
+def test_tsdb_query_with_tags_and_range(tsdb):
+    tsdb.put_data_points([
+        {"metric": "sys.mem", "timestamp": 10, "value": 5.0,
+         "tags": {"host": "a"}},
+        {"metric": "sys.mem", "timestamp": 20, "value": 7.0,
+         "tags": {"host": "b"}},
+    ])
+    only_a = tsdb.query("sys.mem", aggregator="max", tags={"host": "a"})
+    assert only_a["dps"] == {"10": 5.0}
+    ranged = tsdb.query("sys.mem", start=15, end=25)
+    assert ranged["dps"] == {"20": 7.0}
+
+
+def test_tsdb_annotations(tsdb):
+    tsdb.put_annotation({"startTime": 50, "description": "deploy v2"})
+    tsdb.put_annotation({"startTime": 500, "description": "deploy v3"})
+    found = tsdb.query_annotations(0, 100)
+    assert [a["description"] for a in found] == ["deploy v2"]
+
+
+def test_tsdb_bad_point_is_an_error(tsdb):
+    with pytest.raises(OpenTSDBWireError):
+        tsdb.put_data_points([{"metric": "x"}])  # no timestamp/value
+
+
+def test_tsdb_health(tsdb):
+    health = tsdb.health_check()
+    assert health["status"] == "UP"
+    assert health["details"]["version"].startswith("2.4")
+    assert OpenTSDBWire(endpoint="127.0.0.1:1").health_check()["status"] \
+        == "DOWN"

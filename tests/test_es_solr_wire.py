@@ -1,0 +1,113 @@
+"""Elasticsearch and Solr HTTP wire clients against their mini servers."""
+
+import pytest
+
+from gofr_tpu.datasource.document import DocumentNotFound
+from gofr_tpu.datasource.es_wire import (ElasticsearchWire, ESWireError,
+                                         MiniESServer)
+from gofr_tpu.datasource.solr_wire import MiniSolrServer, SolrWire
+
+
+@pytest.fixture(scope="module")
+def es():
+    srv = MiniESServer()
+    srv.start()
+    client = ElasticsearchWire(endpoint=f"127.0.0.1:{srv.port}")
+    client.connect()
+    yield client
+    srv.close()
+
+
+@pytest.fixture(scope="module")
+def solr():
+    srv = MiniSolrServer()
+    srv.start()
+    client = SolrWire(endpoint=f"127.0.0.1:{srv.port}")
+    client.connect()
+    yield client
+    srv.close()
+
+
+# ---------------------------------------------------------------- ES
+
+def test_es_index_get_delete(es):
+    es.index("articles", "a1", {"title": "Ring attention on TPU"})
+    doc = es.get("articles", "a1")
+    assert doc["title"] == "Ring attention on TPU"
+    assert doc["_id"] == "a1"
+    es.delete("articles", "a1")
+    with pytest.raises(DocumentNotFound):
+        es.get("articles", "a1")
+    with pytest.raises(DocumentNotFound):
+        es.delete("articles", "a1")
+
+
+def test_es_match_search_ranks_by_overlap(es):
+    es.index("posts", "1", {"body": "sharding large language models"})
+    es.index("posts", "2", {"body": "sharding models over device mesh"})
+    es.index("posts", "3", {"body": "cooking pasta"})
+    result = es.search("posts", {"match": {"body": "sharding models"}})
+    hits = result["hits"]["hits"]
+    assert [h["_id"] for h in hits[:2]] == ["1", "2"] or \
+        [h["_id"] for h in hits[:2]] == ["2", "1"]
+    assert all(h["_id"] != "3" for h in hits)
+    assert result["hits"]["total"]["value"] == 2
+
+
+def test_es_term_and_match_all(es):
+    es.index("users", "u1", {"role": "admin"})
+    es.index("users", "u2", {"role": "dev"})
+    term = es.search("users", {"term": {"role": "admin"}})
+    assert [h["_id"] for h in term["hits"]["hits"]] == ["u1"]
+    everything = es.search("users", {"match_all": {}})
+    assert everything["hits"]["total"]["value"] == 2
+
+
+def test_es_bulk(es):
+    n = es.bulk("logs", [(str(i), {"n": i}) for i in range(5)])
+    assert n == 5
+    assert es.get("logs", "3")["n"] == 3
+
+
+def test_es_unsupported_query_is_an_error(es):
+    with pytest.raises(ESWireError):
+        es.search("posts", {"fuzzy": {"body": "x"}})
+
+
+def test_es_health(es):
+    assert es.health_check()["status"] == "UP"
+    down = ElasticsearchWire(endpoint="127.0.0.1:1")
+    assert down.health_check()["status"] == "DOWN"
+
+
+# ---------------------------------------------------------------- Solr
+
+def test_solr_add_and_select(solr):
+    solr.add("books", [{"id": "b1", "title": "Systems on TPU"},
+                       {"id": "b2", "title": "Cooking for devs"}])
+    result = solr.search("books", "title:Systems on TPU")
+    assert result["response"]["numFound"] == 1
+    everything = solr.search("books", "*:*")
+    assert everything["response"]["numFound"] == 2
+
+
+def test_solr_bare_text_search(solr):
+    solr.add("notes", [{"id": "n1", "text": "mesh sharding plan"},
+                       {"id": "n2", "text": "grocery list"}])
+    result = solr.search("notes", "sharding")
+    assert [d["id"] for d in result["response"]["docs"]] == ["n1"]
+
+
+def test_solr_delete(solr):
+    solr.add("tmp", [{"id": "t1", "v": 1}])
+    assert solr.search("tmp", "*:*")["response"]["numFound"] == 1
+    solr.delete("tmp", "t1")
+    assert solr.search("tmp", "*:*")["response"]["numFound"] == 0
+
+
+def test_solr_health(solr):
+    health = solr.health_check()
+    assert health["status"] == "UP"
+    assert health["details"]["solr_version"].startswith("9")
+    down = SolrWire(endpoint="127.0.0.1:1")
+    assert down.health_check()["status"] == "DOWN"

@@ -61,6 +61,14 @@ def test_extended_query_with_dollar_params(db):
     assert row["score"] is None and row["note"] is None
 
 
+def test_out_of_range_param_is_protocol_error(db):
+    """$N beyond the bound count is an ErrorResponse, not a torn
+    connection."""
+    with pytest.raises(PostgresError):
+        db.query("SELECT $2 AS x", 1)
+    assert db.query_row("SELECT 3 AS ok")["ok"] == 3  # stream intact
+
+
 def test_param_reuse_order(db):
     """$N placeholders bind by number, not appearance order."""
     row = db.query_row("SELECT $2 AS a, $1 AS b, $2 AS c", 10, 20)
