@@ -193,8 +193,9 @@ def _bench(platform: str, timeout_s: int):
     for line in reversed(out.splitlines()):
         if line.startswith("BENCH_JSON "):
             return json.loads(line[len("BENCH_JSON "):]), ""
-    tail = (err or out).strip().splitlines()
-    return None, f"rc={rc}: {tail[-1] if tail else 'no output'}"
+    # keep the last progress markers so a timeout says which stage hung
+    tail = [ln for ln in (err or out).strip().splitlines() if ln][-3:]
+    return None, f"rc={rc}: {' | '.join(tail) if tail else 'no output'}"
 
 
 def main() -> None:
@@ -208,6 +209,10 @@ def main() -> None:
                   CPU_BENCH_TIMEOUT_S if want == "cpu" else TPU_BENCH_TIMEOUT_S)]
     else:
         if _probe("tpu"):
+            # the axon tunnel has been observed to hang indefinitely at
+            # backend init in SOME processes while a fresh process
+            # connects fine — a second attempt is cheap insurance
+            plans.append(("tpu", TPU_BENCH_TIMEOUT_S))
             plans.append(("tpu", TPU_BENCH_TIMEOUT_S))
         else:
             errors.append("tpu: backend probe failed/timed out")

@@ -23,6 +23,17 @@ Shipped backends:
   Clickhouse/Oracle-shaped) over sqlite.
 - :mod:`.graph` — graph family (Dgraph/ArangoDB/SurrealDB-shaped).
 - :mod:`.timeseries` — time-series family (OpenTSDB/InfluxDB-shaped).
+
+Network wire clients (each speaks its store's real protocol and ships
+a protocol-faithful mini server for hermetic tests; swapping embedded
+for network is a constructor change): :mod:`.redis_wire` (RESP2),
+:mod:`.postgres_wire` (v3 protocol + SCRAM-SHA-256),
+:mod:`.cassandra_wire` (CQL native protocol v4), :mod:`.mongo_wire`
+(OP_MSG), :mod:`.s3_wire` (SigV4), :mod:`.gcs_wire` (JSON API),
+:mod:`.azure_blob_wire` (SharedKey), :mod:`.es_wire`,
+:mod:`.solr_wire`, :mod:`.clickhouse_wire` (HTTP interface),
+:mod:`.influx_wire`, :mod:`.opentsdb_wire`, :mod:`.arango_wire`,
+:mod:`.ftp` (FTP).
 """
 
 import time

@@ -214,9 +214,17 @@ def jwt_verify(token: str, keys: Mapping[str, Any], *,
         raise JWTError(f"no key for kid {kid!r}")
 
     if alg == "RS256":
+        if not hasattr(key, "verify"):  # str/bytes secret, raw JWK dict…
+            raise JWTError("RS256 token but the key is not an RSA "
+                           "public key object")
         if not _verify_rs256(signing_input, signature, key):
             raise JWTError("signature verification failed")
     elif alg == "HS256":
+        # an RSA public key must never act as an HMAC secret — that is
+        # the classic algorithm-confusion attack (attacker signs with
+        # the PUBLIC key bytes and downgrades alg to HS256)
+        if not isinstance(key, (str, bytes)):
+            raise JWTError("HS256 token but the key is not a secret")
         secret = key.encode() if isinstance(key, str) else key
         expected = hmac.new(secret, signing_input, hashlib.sha256).digest()
         if not hmac.compare_digest(expected, signature):

@@ -207,3 +207,114 @@ class TestOAuthEndToEnd:
             status, _, _ = r.request(
                 "GET", "/claims", headers={"Authorization": "Bearer junk"})
             assert status == 401
+
+
+class TestAuthEdgeCases:
+    """Hostile-input edges: malformed headers, algorithm confusion,
+    unknown kids — every one must be a clean 401/None, never a crash."""
+
+    def test_basic_header_malformed_variants(self):
+        from gofr_tpu.http.auth import BasicAuthProvider
+
+        provider = BasicAuthProvider(users={"u": "p"})
+
+        class Req:
+            def __init__(self, header):
+                self._h = header
+                self.path = "/x"
+
+            def header(self, k):
+                return self._h if k == "authorization" else ""
+
+        assert provider.authenticate(Req("")) is None
+        assert provider.authenticate(Req("Basic")) is None
+        assert provider.authenticate(Req("Basic !!!notbase64!!!")) is None
+        # valid base64 but no colon inside
+        nocolon = base64.b64encode(b"justauser").decode()
+        assert provider.authenticate(Req(f"Basic {nocolon}")) is None
+        # Bearer scheme sent to a Basic provider
+        assert provider.authenticate(Req("Bearer abc")) is None
+
+    def test_hs256_token_against_rsa_keys_is_rejected_not_crash(self):
+        """Algorithm-confusion: alg=HS256 with an RSA verification key
+        must raise JWTError (and authenticate -> None), not
+        AttributeError."""
+        from cryptography.hazmat.primitives.asymmetric import rsa
+
+        public = rsa.generate_private_key(
+            public_exponent=65537, key_size=2048).public_key()
+        token = jwt_sign_hs256({"sub": "evil"}, "whatever",
+                               headers={"kid": "k1"})
+        with pytest.raises(JWTError, match="not a secret"):
+            jwt_verify(token, {"k1": public})
+
+        provider = OAuthProvider(keys={"k1": public})
+
+        class Req:
+            path = "/x"
+
+            def header(self, k):
+                return f"Bearer {token}" if k == "authorization" else ""
+
+        assert provider.authenticate(Req()) is None  # no exception
+
+    def test_rs256_token_against_shared_secret_is_rejected(self):
+        token = jwt_sign_hs256({"sub": "x"}, "s")
+        # forge the alg field to RS256 with the same payload
+        header = base64.urlsafe_b64encode(
+            json.dumps({"alg": "RS256"}).encode()).rstrip(b"=").decode()
+        body = token.split(".")[1]
+        forged = f"{header}.{body}.{token.split('.')[2]}"
+        with pytest.raises(JWTError, match="not an RSA"):
+            jwt_verify(forged, {"": "s"})
+
+    def test_alg_none_is_rejected(self):
+        def enc(obj) -> str:
+            return base64.urlsafe_b64encode(
+                json.dumps(obj).encode()).rstrip(b"=").decode()
+
+        token = f"{enc({'alg': 'none'})}.{enc({'sub': 'evil'})}."
+        with pytest.raises(JWTError, match="unsupported alg"):
+            jwt_verify(token, {"": "s"})
+
+    def test_unknown_kid_with_multiple_keys(self):
+        token = jwt_sign_hs256({"sub": "x"}, "right",
+                               headers={"kid": "nope"})
+        with pytest.raises(JWTError, match="no key"):
+            jwt_verify(token, {"a": "right", "b": "other"})
+
+    def test_garbage_tokens(self):
+        for bad in ("two.parts", "a.b.c.d", "", "....",
+                    "!!!.@@@.###"):
+            with pytest.raises(JWTError):
+                jwt_verify(bad, {"": "s"})
+
+    def test_oauth_provider_survives_garbage_bearer_over_server(self):
+        def build(app):
+            from gofr_tpu.http.auth import OAuthProvider, auth_middleware
+            app._middlewares.append(auth_middleware(
+                OAuthProvider(keys={"": "sek"}), scheme="Bearer"))
+            app.get("/p", lambda ctx: "ok")
+
+        with AppRunner(build=build) as r:
+            for header in ({"Authorization": "Bearer not.a.jwt"},
+                           {"Authorization": "Bearer "},
+                           {"Authorization": "Negotiate blah"},
+                           {}):
+                status, _ = r.get_json("/p", headers=header)
+                assert status == 401
+            good = jwt_sign_hs256({"sub": "x"}, "sek")
+            status, _ = r.get_json(
+                "/p", headers={"Authorization": f"Bearer {good}"})
+            assert status == 200
+
+    def test_api_key_empty_and_wrong(self):
+        def build(app):
+            app.enable_api_key_auth("key-1")
+            app.get("/p", lambda ctx: "ok")
+
+        with AppRunner(build=build) as r:
+            assert r.get_json("/p")[0] == 401
+            assert r.get_json("/p", headers={"X-Api-Key": ""})[0] == 401
+            assert r.get_json("/p", headers={"X-Api-Key": "nope"})[0] == 401
+            assert r.get_json("/p", headers={"X-Api-Key": "key-1"})[0] == 200
