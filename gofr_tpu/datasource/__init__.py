@@ -28,12 +28,14 @@ Network wire clients (each speaks its store's real protocol and ships
 a protocol-faithful mini server for hermetic tests; swapping embedded
 for network is a constructor change): :mod:`.redis_wire` (RESP2),
 :mod:`.postgres_wire` (v3 protocol + SCRAM-SHA-256),
-:mod:`.cassandra_wire` (CQL native protocol v4), :mod:`.mongo_wire`
-(OP_MSG), :mod:`.s3_wire` (SigV4), :mod:`.gcs_wire` (JSON API),
-:mod:`.azure_blob_wire` (SharedKey), :mod:`.es_wire`,
-:mod:`.solr_wire`, :mod:`.clickhouse_wire` (HTTP interface),
-:mod:`.influx_wire`, :mod:`.opentsdb_wire`, :mod:`.arango_wire`,
-:mod:`.ftp` (FTP).
+:mod:`.cassandra_wire` (CQL native protocol v4, incl. ``ScyllaWire``),
+:mod:`.couchbase_wire` (memcached binary KV + N1QL HTTP),
+:mod:`.mongo_wire` (OP_MSG), :mod:`.s3_wire` (SigV4),
+:mod:`.gcs_wire` (JSON API), :mod:`.azure_blob_wire` (SharedKey),
+:mod:`.es_wire`, :mod:`.solr_wire`, :mod:`.clickhouse_wire` (HTTP
+interface), :mod:`.influx_wire`, :mod:`.opentsdb_wire`,
+:mod:`.arango_wire`, :mod:`.dgraph_wire` (generated DQL),
+:mod:`.surreal_wire` (WebSocket JSON-RPC), :mod:`.ftp` (FTP).
 """
 
 import time

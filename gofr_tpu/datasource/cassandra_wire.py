@@ -325,6 +325,15 @@ class CassandraWire(Instrumented):
             self._frames = None
 
 
+class ScyllaWire(CassandraWire):
+    """ScyllaDB speaks the same CQL native protocol (reference
+    container/datasources.go:600-635 keeps a separate surface; only
+    the metrics identity differs here)."""
+
+    metric = "app_scylladb_stats"
+    log_tag = "SCYLLA"
+
+
 def _parse_result(payload: bytes) -> list[dict]:
     (kind,) = struct.unpack_from("!I", payload, 0)
     if kind != RESULT_ROWS:

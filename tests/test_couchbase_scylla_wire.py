@@ -1,0 +1,94 @@
+"""Couchbase (memcached binary KV + N1QL HTTP) and ScyllaDB (CQL)
+wire clients against their mini servers."""
+
+import pytest
+
+from gofr_tpu.datasource.cassandra_wire import (MiniCassandraServer,
+                                                ScyllaWire)
+from gofr_tpu.datasource.couchbase_wire import (CouchbaseWire,
+                                                CouchbaseWireError,
+                                                MiniCouchbaseServer)
+from gofr_tpu.datasource.document import DocumentError, DocumentNotFound
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = MiniCouchbaseServer(username="app", password="pw")
+    srv.start()
+    yield srv
+    srv.close()
+
+
+@pytest.fixture()
+def cb(server):
+    client = CouchbaseWire(
+        host="127.0.0.1", kv_port=server.kv_port,
+        query_endpoint=f"127.0.0.1:{server.query_port}",
+        username="app", password="pw")
+    client.connect()
+    yield client
+    client.close()
+
+
+def test_kv_roundtrip_over_binary_protocol(cb):
+    cb.upsert("profiles", "u1", {"name": "ada", "score": 9})
+    assert cb.get("profiles", "u1") == {"name": "ada", "score": 9}
+    cb.upsert("profiles", "u1", {"name": "ada", "score": 10})
+    assert cb.get("profiles", "u1")["score"] == 10
+    cb.remove("profiles", "u1")
+    with pytest.raises(DocumentNotFound):
+        cb.get("profiles", "u1")
+    with pytest.raises(DocumentNotFound):
+        cb.remove("profiles", "u1")
+
+
+def test_insert_conflicts_on_existing_key(cb):
+    cb.upsert("tickets", "t1", {"state": "open"})
+    with pytest.raises(DocumentError, match="duplicate"):
+        cb.insert("tickets", "t1", {"state": "new"})
+    cb.insert("tickets", "t2", {"state": "new"})
+    assert cb.get("tickets", "t2")["state"] == "new"
+
+
+def test_n1ql_query_with_named_args(cb):
+    cb.upsert("fleet", "a", {"kind": "v5e", "up": True})
+    cb.upsert("fleet", "b", {"kind": "v5p", "up": True})
+    cb.upsert("fleet", "c", {"kind": "v5e", "up": False})
+    rows = cb.query("fleet", {"kind": "v5e", "up": True})
+    assert len(rows) == 1 and rows[0]["up"] is True
+    assert len(cb.query("fleet")) == 3
+
+
+def test_injection_shaped_identifiers_rejected(cb):
+    with pytest.raises(CouchbaseWireError, match="invalid field"):
+        cb.query("fleet", {'x` = "" OR 1=1 OR `y': "v"})
+    with pytest.raises(CouchbaseWireError, match="invalid bucket"):
+        cb.query("b` d; DROP `x", {})
+
+
+def test_wrong_password_rejected(server):
+    bad = CouchbaseWire(host="127.0.0.1", kv_port=server.kv_port,
+                        username="app", password="WRONG")
+    with pytest.raises(CouchbaseWireError, match="SASL"):
+        bad.connect()
+
+
+def test_health(cb):
+    health = cb.health_check()
+    assert health["status"] == "UP"
+    assert "PLAIN" in health["details"]["mechs"]
+
+
+def test_scylla_speaks_cql(tmp_path):
+    srv = MiniCassandraServer()
+    srv.start()
+    try:
+        db = ScyllaWire(host="127.0.0.1", port=srv.port)
+        db.connect()
+        db.exec("CREATE TABLE heat (id INTEGER, c REAL)")
+        db.exec("INSERT INTO heat VALUES (?, ?)", 1, 42.0)
+        assert db.query("SELECT c FROM heat")[0]["c"] == 42.0
+        assert db.metric == "app_scylladb_stats"
+        db.close()
+    finally:
+        srv.close()

@@ -1,0 +1,84 @@
+"""Dgraph HTTP wire client against the mini server — the client emits
+real DQL; the server parses exactly that subset."""
+
+import pytest
+
+from gofr_tpu.datasource.dgraph_wire import (DgraphWire, DgraphWireError,
+                                             MiniDgraphServer,
+                                             build_query_dql)
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = MiniDgraphServer()
+    srv.start()
+    yield srv
+    srv.close()
+
+
+@pytest.fixture()
+def db(server):
+    client = DgraphWire(endpoint=f"127.0.0.1:{server.port}")
+    client.connect()
+    return client
+
+
+def test_dql_generation():
+    assert build_query_dql({}) \
+        == "{ q(func: has(dgraph.type)) { uid expand(_all_) } }"
+    assert build_query_dql({"name": "ada"}) \
+        == '{ q(func: eq(name, "ada")) { uid expand(_all_) } }'
+    dql = build_query_dql({"name": 'a"b', "age": 36}, expand="friend")
+    assert dql == ('{ q(func: eq(age, 36)) @filter(eq(name, "a\\"b"))'
+                   " { uid expand(_all_) friend { uid expand(_all_) } } }")
+
+
+def test_mutate_and_query(db):
+    uids = db.mutate([{"uid": "_:a", "name": "ada", "age": 36},
+                      {"uid": "_:g", "name": "grace", "age": 30}])
+    assert set(uids) == {"a", "g"}
+    rows = db.query({"name": "ada"})
+    assert len(rows) == 1 and rows[0]["age"] == 36
+    assert rows[0]["uid"]
+
+
+def test_query_with_filter_and_expand(db):
+    db.mutate({"name": "linus", "knows": [{"name": "andrew"}]})
+    rows = db.query({"name": "linus"}, expand="knows")
+    assert rows and rows[0]["knows"][0]["name"] == "andrew"
+
+
+def test_numeric_and_bool_predicates(db):
+    db.mutate({"name": "flagged", "active": True, "rank": 2.5})
+    rows = db.query({"active": True, "rank": 2.5})
+    assert any(r["name"] == "flagged" for r in rows)
+
+
+def test_alter_and_errors(db):
+    db.alter("name: string @index(term) .")
+    # by-hand DQL outside the supported subset: dgraph-style in-body error
+    status, data = db._call(
+        "/query", b"{ q(func: regexp(name, /a/)) { uid } }",
+        "application/dql")
+    assert status == 200 and data.get("errors")
+    with pytest.raises(DgraphWireError):
+        DgraphWire._check(status, data, "query")
+
+
+def test_values_containing_and_or_parens(db):
+    """Quoted values with \" AND \" or \")\" survive generation AND
+    mini-server parsing (review regression)."""
+    db.mutate({"name": "rock AND roll (live)", "n": 1})
+    rows = db.query({"name": "rock AND roll (live)", "n": 1})
+    assert rows and rows[0]["n"] == 1
+
+
+def test_injection_shaped_predicate_rejected(db):
+    with pytest.raises(DgraphWireError, match="invalid predicate"):
+        db.query({'name) { uid } } { q2(func: has(x)': "v"})
+
+
+def test_health(db):
+    assert db.health_check()["status"] == "UP"
+    assert DgraphWire(endpoint="127.0.0.1:1").health_check()["status"] \
+        == "DOWN"

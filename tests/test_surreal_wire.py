@@ -1,0 +1,90 @@
+"""SurrealDB WebSocket JSON-RPC wire client against the mini server —
+the framework's own WS runtime serving the RPC surface."""
+
+import pytest
+
+from gofr_tpu.datasource.surreal_wire import (MiniSurrealServer,
+                                              SurrealWire, SurrealWireError)
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = MiniSurrealServer(username="root", password="pw")
+    srv.start()
+    yield srv
+    srv.close()
+
+
+@pytest.fixture()
+def db(server):
+    client = SurrealWire(endpoint=f"ws://127.0.0.1:{server.port}/rpc",
+                         username="root", password="pw")
+    client.connect()
+    yield client
+    client.close()
+
+
+def test_create_select_update_delete(db):
+    doc = db.create("person:ada", {"name": "ada", "year": 1815})
+    assert doc["id"] == "person:ada"
+    assert doc["name"] == "ada"
+    got = db.select("person:ada")
+    assert got[0]["year"] == 1815
+    updated = db.update("person:ada", {"name": "ada", "year": 1816})
+    assert updated["year"] == 1816
+    db.delete("person:ada")
+    with pytest.raises(SurrealWireError):
+        db.select("person:ada")
+
+
+def test_create_without_id_assigns_one(db):
+    doc = db.create("event", {"kind": "deploy"})
+    assert doc["id"].startswith("event:")
+    db.delete(doc["id"])
+
+
+def test_query_generates_surrealql_with_vars(db):
+    db.create("city:pisa", {"name": "pisa", "country": "it"})
+    db.create("city:rome", {"name": "rome", "country": "it"})
+    db.create("city:lyon", {"name": "lyon", "country": "fr"})
+    rows = db.query("city", {"country": "it"})
+    assert {r["name"] for r in rows} == {"pisa", "rome"}
+    assert len(db.query("city")) == 3
+    for c in ("pisa", "rome", "lyon"):
+        db.delete(f"city:{c}")
+
+
+def test_signin_required(server):
+    anon = SurrealWire(endpoint=f"ws://127.0.0.1:{server.port}/rpc",
+                       username="", password="")
+    anon.connect()  # no signin attempted
+    try:
+        with pytest.raises(SurrealWireError, match="not signed in"):
+            anon.create("x:1", {"a": 1})
+    finally:
+        anon.close()
+
+
+def test_bad_credentials_rejected(server):
+    bad = SurrealWire(endpoint=f"ws://127.0.0.1:{server.port}/rpc",
+                      username="root", password="WRONG")
+    with pytest.raises(SurrealWireError, match="credentials"):
+        bad.connect()
+    bad.close()
+
+
+def test_malformed_rpc_params_get_immediate_error(db):
+    # one param where two are required: a JSON-RPC error, not a stall
+    with pytest.raises(SurrealWireError, match="invalid params"):
+        db._rpc("create", ["only-thing"])
+
+
+def test_injection_shaped_field_name_rejected(db):
+    with pytest.raises(SurrealWireError, match="invalid field"):
+        db.query("t", {"x = 1 OR true; DROP": "v"})
+
+
+def test_health(db):
+    assert db.health_check()["status"] == "UP"
+    loose = SurrealWire(endpoint="ws://127.0.0.1:1/rpc")
+    assert loose.health_check()["status"] == "DOWN"
