@@ -184,8 +184,13 @@ class CouchbaseWire(Instrumented):
     # ----------------------------------------------------- native verbs
     def get(self, bucket: str, key: str) -> dict:
         def op():
-            self._select_bucket(bucket)
-            _, status, _, _, value = self._round(OP_GET, key=key.encode())
+            # one lock scope for select+op: another thread's bucket
+            # switch must not land between them (server-side bucket
+            # state is per-connection)
+            with self._lock:
+                self._select_bucket(bucket)
+                _, status, _, _, value = self._round(OP_GET,
+                                                     key=key.encode())
             if status == STATUS_NOT_FOUND:
                 raise DocumentNotFound(f"{bucket}/{key}")
             if status != STATUS_OK:
@@ -196,11 +201,12 @@ class CouchbaseWire(Instrumented):
 
     def _store(self, opcode: int, bucket: str, key: str,
                document: dict) -> int:
-        self._select_bucket(bucket)
-        extras = struct.pack("!II", 0, 0)  # flags, expiry
-        _, status, _, _, _ = self._round(
-            opcode, key=key.encode(), extras=extras,
-            value=json.dumps(document).encode())
+        with self._lock:  # select+op atomically, see get()
+            self._select_bucket(bucket)
+            extras = struct.pack("!II", 0, 0)  # flags, expiry
+            _, status, _, _, _ = self._round(
+                opcode, key=key.encode(), extras=extras,
+                value=json.dumps(document).encode())
         return status
 
     def upsert(self, bucket: str, key: str, document: dict) -> None:
@@ -223,8 +229,10 @@ class CouchbaseWire(Instrumented):
 
     def remove(self, bucket: str, key: str) -> None:
         def op():
-            self._select_bucket(bucket)
-            _, status, _, _, _ = self._round(OP_DELETE, key=key.encode())
+            with self._lock:  # select+op atomically, see get()
+                self._select_bucket(bucket)
+                _, status, _, _, _ = self._round(OP_DELETE,
+                                                 key=key.encode())
             if status == STATUS_NOT_FOUND:
                 raise DocumentNotFound(f"{bucket}/{key}")
             if status != STATUS_OK:

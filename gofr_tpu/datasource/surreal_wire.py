@@ -73,20 +73,29 @@ class SurrealWire(Instrumented):
             self._loop = loop
             asyncio.set_event_loop(loop)
             ready.set()
-            loop.run_forever()
+            try:
+                loop.run_forever()
+            finally:
+                loop.close()  # release the selector fd
 
         self._thread = threading.Thread(target=run, daemon=True,
                                         name="surreal-wire")
         self._thread.start()
         ready.wait(5)
 
-        from ..websocket.service import connect as ws_connect
-        self._conn = self._run(ws_connect(self.endpoint,
-                                          timeout=self.timeout_s))
-        if self.username:
-            self._rpc("signin", [{"user": self.username,
-                                  "pass": self.password}])
-        self._rpc("use", [self.namespace, self.database])
+        try:
+            from ..websocket.service import connect as ws_connect
+            self._conn = self._run(ws_connect(self.endpoint,
+                                              timeout=self.timeout_s))
+            if self.username:
+                self._rpc("signin", [{"user": self.username,
+                                      "pass": self.password}])
+            self._rpc("use", [self.namespace, self.database])
+        except BaseException:
+            # a failed connect must not strand the loop thread — each
+            # reconnect attempt would otherwise leak a thread + fd
+            self.close()
+            raise
         if self.logger is not None:
             self.logger.info("connected to surrealdb",
                              endpoint=self.endpoint, ns=self.namespace,

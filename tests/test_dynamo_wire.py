@@ -1,0 +1,67 @@
+"""DynamoDB JSON-1.0 wire client (SigV4-signed) against the mini
+server."""
+
+import pytest
+
+from gofr_tpu.datasource.dynamo_wire import (DynamoError, DynamoKV,
+                                             MiniDynamoServer)
+from gofr_tpu.datasource.kv import KeyNotFound
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = MiniDynamoServer(access_key="AKID", secret_key="s3cr3t")
+    srv.start()
+    yield srv
+    srv.close()
+
+
+@pytest.fixture()
+def kv(server):
+    client = DynamoKV(endpoint=f"127.0.0.1:{server.port}",
+                      table="t", access_key="AKID", secret_key="s3cr3t")
+    client.connect()
+    return client
+
+
+def test_kv_roundtrip(kv):
+    kv.set("alpha", "1")
+    kv.set("beta", "two")
+    assert kv.get("alpha") == "1"
+    kv.set("alpha", "updated")
+    assert kv.get("alpha") == "updated"
+    kv.delete("alpha")
+    with pytest.raises(KeyNotFound):
+        kv.get("alpha")
+    with pytest.raises(KeyNotFound):
+        kv.delete("alpha")
+    kv.delete("beta")
+
+
+def test_keys_follow_scan_pagination(kv, monkeypatch):
+    for i in range(7):
+        kv.set(f"p{i}", "x")
+    monkeypatch.setattr("gofr_tpu.datasource.dynamo_wire._SCAN_PAGE", 3)
+    assert kv.keys() == [f"p{i}" for i in range(7)]
+    for i in range(7):
+        kv.delete(f"p{i}")
+
+
+def test_wrong_secret_rejected(server):
+    bad = DynamoKV(endpoint=f"127.0.0.1:{server.port}", table="t",
+                   access_key="AKID", secret_key="WRONG")
+    with pytest.raises(DynamoError, match="403"):
+        bad.set("k", "v")
+    assert bad.health_check()["status"] == "DOWN"
+
+
+def test_unicode_values(kv):
+    kv.set("uni", "héllo ∆ 中文")
+    assert kv.get("uni") == "héllo ∆ 中文"
+    kv.delete("uni")
+
+
+def test_health(kv):
+    assert kv.health_check()["status"] == "UP"
+    assert DynamoKV(endpoint="127.0.0.1:1",
+                    table="t").health_check()["status"] == "DOWN"
