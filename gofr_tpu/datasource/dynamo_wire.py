@@ -25,8 +25,7 @@ import urllib.parse
 import urllib.request
 from typing import Any
 
-from . import ProviderMixin
-from .kv import KeyNotFound, KVError
+from .kv import KeyNotFound, KVError, _Instrumented
 from .miniserver import ThreadedHTTPMiniServer
 from .s3_wire import sign_v4
 
@@ -38,9 +37,10 @@ class DynamoError(KVError):
     pass
 
 
-class DynamoKV(ProviderMixin):
+class DynamoKV(_Instrumented):
     """SigV4-signed DynamoDB client behind the KV surface. String
-    values live in attribute ``v`` under partition key ``k``."""
+    values live in attribute ``v`` under partition key ``k``; every op
+    records into ``app_kv_stats`` like the other KV backends."""
 
     def __init__(self, *, endpoint: str = "https://dynamodb.us-east-1.amazonaws.com",
                  table: str = "gofr_kv", access_key: str = "",
@@ -94,39 +94,47 @@ class DynamoKV(ProviderMixin):
 
     # --------------------------------------------------------- KV verbs
     def get(self, key: str) -> str:
-        data = self._checked("GetItem", {
-            "TableName": self.table,
-            "Key": {"k": {"S": key}}, "ConsistentRead": True})
-        item = data.get("Item")
-        if not item:
-            raise KeyNotFound(key)
-        return item["v"]["S"]
+        def op():
+            data = self._checked("GetItem", {
+                "TableName": self.table,
+                "Key": {"k": {"S": key}}, "ConsistentRead": True})
+            item = data.get("Item")
+            if not item:
+                raise KeyNotFound(key)
+            return item["v"]["S"]
+        return self._observed("GET", key, op)
 
     def set(self, key: str, value: str) -> None:
-        self._checked("PutItem", {
-            "TableName": self.table,
-            "Item": {"k": {"S": key}, "v": {"S": str(value)}}})
+        def op():
+            self._checked("PutItem", {
+                "TableName": self.table,
+                "Item": {"k": {"S": key}, "v": {"S": str(value)}}})
+        self._observed("SET", key, op)
 
     def delete(self, key: str) -> None:
-        data = self._checked("DeleteItem", {
-            "TableName": self.table, "Key": {"k": {"S": key}},
-            "ReturnValues": "ALL_OLD"})
-        if not data.get("Attributes"):
-            raise KeyNotFound(key)
+        # idempotent like the other KV backends: deleting an absent
+        # key is a no-op, not an error
+        def op():
+            self._checked("DeleteItem", {
+                "TableName": self.table, "Key": {"k": {"S": key}}})
+        self._observed("DELETE", key, op)
 
     def keys(self) -> list[str]:
-        out: list[str] = []
-        start: dict | None = None
-        while True:  # follow LastEvaluatedKey pagination to the end
-            body: dict[str, Any] = {"TableName": self.table,
-                                    "ProjectionExpression": "k"}
-            if start:
-                body["ExclusiveStartKey"] = start
-            data = self._checked("Scan", body)
-            out.extend(item["k"]["S"] for item in data.get("Items", []))
-            start = data.get("LastEvaluatedKey")
-            if not start:
-                return sorted(out)
+        def op():
+            out: list[str] = []
+            start: dict | None = None
+            while True:  # follow LastEvaluatedKey pagination to the end
+                body: dict[str, Any] = {"TableName": self.table,
+                                        "ProjectionExpression": "k"}
+                if start:
+                    body["ExclusiveStartKey"] = start
+                data = self._checked("Scan", body)
+                out.extend(item["k"]["S"]
+                           for item in data.get("Items", []))
+                start = data.get("LastEvaluatedKey")
+                if not start:
+                    return sorted(out)
+        return self._observed("KEYS", "*", op)
 
     def health_check(self) -> dict[str, Any]:
         try:

@@ -1,0 +1,128 @@
+"""SFTP over the from-spec SSH2 transport: full-stack wire tests —
+curve25519 kex, aes128-ctr + hmac-sha2-256, password auth, channels,
+SFTP v3 — against the mini SSH server."""
+
+import io
+
+import pytest
+
+from gofr_tpu.datasource.sftp_wire import MiniSFTPServer, SFTPError, SFTPWire
+from gofr_tpu.datasource.ssh_transport import SSHAuthError, SSHError
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    root = tmp_path_factory.mktemp("sftp_root")
+    srv = MiniSFTPServer(root, users={"app": "s3cr3t"})
+    srv.start()
+    yield srv
+    srv.close()
+
+
+@pytest.fixture()
+def fs(server):
+    client = SFTPWire(host="127.0.0.1", port=server.port,
+                      username="app", password="s3cr3t",
+                      expected_host_key=server.host_public_key())
+    client.connect()
+    yield client
+    client.close()
+
+
+def test_create_read_roundtrip(fs):
+    fs.create("hello.txt", "hello over ssh\n")
+    assert fs.read_text("hello.txt") == "hello over ssh\n"
+    payload = bytes(range(256)) * 512  # 128 KB: multiple READ/WRITE chunks
+    fs.create("blob.bin", payload)
+    assert fs.read("blob.bin") == payload
+
+
+def test_append_stat_exists(fs):
+    fs.create("log.txt", "one\n")
+    fs.append("log.txt", "two\n")
+    assert fs.read_text("log.txt") == "one\ntwo\n"
+    info = fs.stat("log.txt")
+    assert info.size == 8 and not info.is_dir and info.mod_time > 0
+    assert fs.exists("log.txt") is True
+    assert fs.exists("nope.txt") is False
+
+
+def test_mkdir_readdir_rename_remove(fs):
+    fs.mkdir("data")
+    fs.create("data/a.csv", "x,y\n1,2\n")
+    fs.create("data/b.csv", "x,y\n3,4\n")
+    names = [f.name for f in fs.read_dir("data")]
+    assert names == ["a.csv", "b.csv"]
+    root_entries = {f.name: f for f in fs.read_dir("/")}
+    assert root_entries["data"].is_dir
+    fs.rename("data/a.csv", "data/renamed.csv")
+    assert fs.exists("data/renamed.csv") and not fs.exists("data/a.csv")
+    rows = list(fs.read_rows("data/renamed.csv"))
+    assert rows == [{"x": "1", "y": "2"}]
+    fs.remove("data/renamed.csv")
+    fs.remove("data/b.csv")
+    fs.rmdir("data")
+    assert not fs.exists("data")
+
+
+def test_missing_file_errors(fs):
+    with pytest.raises(SFTPError, match="no such file"):
+        fs.read("missing.bin")
+    with pytest.raises(SFTPError):
+        fs.remove("missing.bin")
+    with pytest.raises(SFTPError):
+        fs.stat("missing.bin")
+
+
+def test_path_jail(fs, server):
+    fs.create("../escape.txt", "jailed")  # normalized inside the root
+    assert (server.root / "escape.txt").exists()
+    assert not (server.root.parent / "escape.txt").exists()
+    fs.remove("escape.txt")
+
+
+def test_wrong_password_rejected(server):
+    bad = SFTPWire(host="127.0.0.1", port=server.port,
+                   username="app", password="WRONG")
+    with pytest.raises(SSHAuthError):
+        bad.connect()
+
+
+def test_host_key_pinning_detects_mitm(server):
+    pinned = SFTPWire(host="127.0.0.1", port=server.port,
+                      username="app", password="s3cr3t",
+                      expected_host_key=b"\x00" * 32)
+    with pytest.raises(SSHError, match="host key mismatch"):
+        pinned.connect()
+
+
+def test_paramiko_style_aliases(fs):
+    fs.putfo(io.BytesIO(b"injected"), "via_putfo.bin")
+    buf = io.BytesIO()
+    fs.getfo("via_putfo.bin", buf)
+    assert buf.getvalue() == b"injected"
+    assert "via_putfo.bin" in fs.listdir("/")
+    fs.remove("via_putfo.bin")
+
+
+def test_injected_into_existing_sftp_filesystem(server):
+    """The previously injection-only SFTPFileSystem accepts this
+    native client (ftp.py's paramiko-style contract)."""
+    from gofr_tpu.datasource.ftp import SFTPFileSystem
+
+    wire = SFTPWire(host="127.0.0.1", port=server.port,
+                    username="app", password="s3cr3t")
+    wire.connect()
+    fs = SFTPFileSystem(client=wire)
+    fs.connect()
+    fs.create("nested.txt", "through the adapter")
+    assert fs.read("nested.txt") == b"through the adapter"
+    assert "nested.txt" in [f.name for f in fs.read_dir("/")]
+    fs.remove("nested.txt")
+    wire.close()
+
+
+def test_health(fs):
+    assert fs.health_check()["status"] == "UP"
+    assert SFTPWire(host="127.0.0.1", port=1).health_check()["status"] \
+        == "DOWN"
