@@ -28,6 +28,7 @@ Network wire clients (each speaks its store's real protocol and ships
 a protocol-faithful mini server for hermetic tests; swapping embedded
 for network is a constructor change): :mod:`.redis_wire` (RESP2),
 :mod:`.postgres_wire` (v3 protocol + SCRAM-SHA-256),
+:mod:`.mysql_wire` (v10 handshake + native-password auth + COM_QUERY),
 :mod:`.cassandra_wire` (CQL native protocol v4, incl. ``ScyllaWire``),
 :mod:`.couchbase_wire` (memcached binary KV + N1QL HTTP),
 :mod:`.mongo_wire` (OP_MSG), :mod:`.s3_wire` (SigV4),

@@ -6,13 +6,14 @@ structured ``QueryLog`` and an ``app_sql_stats`` histogram sample
 (db.go:47-60), plus an ORM-lite ``select`` that maps rows into
 dataclasses (db.go:214) and a transaction wrapper (db.go:124).
 
-Backends: sqlite (stdlib, always available) and network postgres-family
+Backends: sqlite (stdlib, always available), network postgres-family
 servers via :class:`~gofr_tpu.datasource.postgres_wire.PostgresWire`
-(the v3 wire protocol, selected by ``DB_DIALECT=postgres`` +
-``DB_HOST``). The mysql dialect is accepted for query-building
-(placeholder style, AUTOINCREMENT spelling) so the query builder and
-auto-CRUD work identically, but connecting requires a driver this
-image doesn't ship — ``connect`` raises a clear error for it.
+(the v3 wire protocol, ``DB_DIALECT=postgres`` + ``DB_HOST``), and
+network mysql servers via
+:class:`~gofr_tpu.datasource.mysql_wire.MySQLWire` (the client/server
+protocol, ``DB_DIALECT=mysql`` + ``DB_HOST``). All dialects share the
+query builder and auto-CRUD (placeholder style, AUTOINCREMENT
+spelling).
 """
 
 from __future__ import annotations
@@ -272,22 +273,31 @@ def new_sql(config: Any, logger: Any = None, metrics: Any = None,
     if not dialect:
         return None
     host = config.get("DB_HOST")
-    if dialect in _DOLLAR_PLACEHOLDER and host:
-        # a network postgres-family server: dial it over the v3 wire
-        # protocol (reference sql.go:74 does this via lib/pq)
-        from .postgres_wire import PostgresWire
+    if host and (dialect in _DOLLAR_PLACEHOLDER
+                 or dialect == DIALECT_MYSQL):
+        # a network server: dial it over the real wire protocol
+        # (reference sql.go:74 does this via lib/pq / go-sql-driver)
+        default_port = "3306" if dialect == DIALECT_MYSQL else "5432"
         try:
-            port = int(config.get_or_default("DB_PORT", "5432").strip())
+            port = int(config.get_or_default("DB_PORT",
+                                             default_port).strip())
         except ValueError:
             if logger is not None:
                 logger.error("SQL disabled: DB_PORT is not an integer")
             return None
-        db = PostgresWire(
-            host=host,
-            port=port,
-            user=config.get_or_default("DB_USER", "postgres"),
-            password=config.get_or_default("DB_PASSWORD", ""),
-            database=config.get_or_default("DB_NAME", "postgres"))
+        user = config.get_or_default(
+            "DB_USER", "root" if dialect == DIALECT_MYSQL else "postgres")
+        password = config.get_or_default("DB_PASSWORD", "")
+        name = config.get_or_default(
+            "DB_NAME", "" if dialect == DIALECT_MYSQL else "postgres")
+        if dialect == DIALECT_MYSQL:
+            from .mysql_wire import MySQLWire
+            db: Any = MySQLWire(host=host, port=port, user=user,
+                                password=password, database=name)
+        else:
+            from .postgres_wire import PostgresWire
+            db = PostgresWire(host=host, port=port, user=user,
+                              password=password, database=name)
         for use, obj in (("use_logger", logger), ("use_metrics", metrics),
                          ("use_tracer", tracer)):
             if obj is not None:
