@@ -253,8 +253,10 @@ class MySQLWire(ProviderMixin):
             auth_len = greeting[off]
             off += 1 + 10  # reserved
             tail = max(13, auth_len - 8) if auth_len else 13
-            scramble += greeting[off:off + tail].rstrip(b"\0")
-            scramble = scramble[:20]
+            part2 = greeting[off:off + tail]
+            if part2.endswith(b"\0"):  # exactly the one terminator —
+                part2 = part2[:-1]      # scramble bytes may BE 0x00
+            scramble = (scramble + part2)[:20]
 
             token = native_password_scramble(self.password, scramble)
             caps = _CAPS | (0x08 if self.database else 0)  # CONNECT_WITH_DB
@@ -276,7 +278,10 @@ class MySQLWire(ProviderMixin):
                     raise MySQLError(
                         f"server requires auth plugin {plugin!r}; only "
                         "mysql_native_password is supported")
-                new_scramble = reply[end + 1:].rstrip(b"\0")[:20]
+                new_scramble = reply[end + 1:]
+                if new_scramble.endswith(b"\0"):
+                    new_scramble = new_scramble[:-1]
+                new_scramble = new_scramble[:20]
                 packets.send(native_password_scramble(
                     self.password, new_scramble))
                 reply = packets.recv()
@@ -334,9 +339,9 @@ class MySQLWire(ProviderMixin):
                 affected, off = read_lenenc(first, 1)
                 return [], affected or 0
             ncols, _ = read_lenenc(first, 0)
-            names = []
+            columns = []
             for _ in range(ncols or 0):
-                names.append(self._column_name(packets.recv()))
+                columns.append(self._column_def(packets.recv()))
             payload = packets.recv()  # EOF closing the column block
             if not (payload[:1] == b"\xfe" and len(payload) < 9):
                 raise MySQLError("expected EOF after column definitions")
@@ -349,31 +354,58 @@ class MySQLWire(ProviderMixin):
                     raise self._err(payload)
                 row = MySQLRow()
                 off = 0
-                for name in names:
-                    value, off = self._read_value(payload, off)
+                for name, type_id in columns:
+                    value, off = self._read_value(payload, off, type_id)
                     row[name] = value
                 rows.append(row)
         except (OSError, TimeoutError) as exc:
             self.close()  # poisoned stream: replies would misalign
             raise MySQLError(
                 f"connection lost mid-query ({exc})") from exc
+        except MySQLError as exc:
+            # server ERR packets (code != 0) leave the stream aligned;
+            # structural errors (code 0) mean unread packets remain
+            if exc.code == 0:
+                self.close()
+            raise
+        except (struct.error, IndexError) as exc:
+            self.close()
+            raise MySQLError(f"malformed packet ({exc})") from exc
 
     @staticmethod
-    def _column_name(payload: bytes) -> str:
+    def _column_def(payload: bytes) -> tuple[str, int]:
+        """-> (name, type byte) from a column-definition packet."""
         off = 0
         for _ in range(4):  # catalog, schema, table, org_table
             n, off = read_lenenc(payload, off)
             off += n or 0
         n, off = read_lenenc(payload, off)
-        return payload[off:off + (n or 0)].decode()
+        name = payload[off:off + (n or 0)].decode()
+        off += n or 0
+        n, off = read_lenenc(payload, off)  # org_name
+        off += n or 0
+        off += 1 + 2 + 4  # fixed-len marker, charset, column length
+        type_id = payload[off] if off < len(payload) else TYPE_VAR_STRING
+        return name, type_id
 
     @staticmethod
-    def _read_value(payload: bytes, off: int) -> tuple[Any, int]:
+    def _read_value(payload: bytes, off: int,
+                    type_id: int) -> tuple[Any, int]:
         n, off = read_lenenc(payload, off)
         if n is None:
             return None, off
         raw = payload[off:off + n]
-        return raw.decode("utf-8", "surrogateescape"), off + n
+        off += n
+        try:
+            if type_id in (TYPE_LONGLONG, 0x01, 0x02, 0x03, 0x09):
+                return int(raw), off
+            if type_id in (TYPE_DOUBLE, 0x04, 0x00):  # double/float/dec
+                return float(raw), off
+            if type_id == TYPE_BLOB:
+                return bytes(raw), off
+        except ValueError:
+            pass  # mixed-type sqlite column behind the mini server
+        return raw.decode("utf-8", "surrogateescape"), off
 
     # --------------------------------------------------- public surface
     def _observe(self, query: str, args: tuple, start: float) -> None:
@@ -435,7 +467,6 @@ class MySQLWire(ProviderMixin):
         from dataclasses import fields, is_dataclass
         if not is_dataclass(entity_type):
             raise SQLError("select requires a dataclass type")
-        names = [f.name for f in fields(entity_type)]
         out = []
         for row in self.query(query, *args):
             kwargs = {}
@@ -517,7 +548,8 @@ class _MySQLHandler(socketserver.BaseRequestHandler):
         import os
         packets = _Packets(self.request)
         try:
-            scramble = os.urandom(20)
+            # real mysqld salts avoid NUL (it terminates the field)
+            scramble = bytes(b % 255 + 1 for b in os.urandom(20))
             greeting = bytes([10]) + b"8.0-mini\0" \
                 + struct.pack("<I", 1) + scramble[:8] + b"\0" \
                 + struct.pack("<H", _CAPS & 0xFFFF) + bytes([0x21]) \
@@ -582,14 +614,24 @@ class _MySQLHandler(socketserver.BaseRequestHandler):
             return
         names = [d[0] for d in cur.description]
         packets.send(lenenc(len(names)))
-        for name in names:
+        for idx, name in enumerate(names):
+            sample = next((row[idx] for row in rows
+                           if row[idx] is not None), None)
+            if isinstance(sample, int) and not isinstance(sample, bool):
+                type_id = TYPE_LONGLONG
+            elif isinstance(sample, float):
+                type_id = TYPE_DOUBLE
+            elif isinstance(sample, bytes):
+                type_id = TYPE_BLOB
+            else:
+                type_id = TYPE_VAR_STRING
             payload = b""
             for field in ("def", "", "t", "t"):
                 payload += lenenc(len(field)) + field.encode()
             payload += lenenc(len(name)) + name.encode()
             payload += lenenc(len(name)) + name.encode()
             payload += bytes([0x0C]) + struct.pack("<H", 0x21) \
-                + struct.pack("<I", 1024) + bytes([TYPE_VAR_STRING]) \
+                + struct.pack("<I", 1024) + bytes([type_id]) \
                 + struct.pack("<H", 0) + bytes([0, 0, 0])
             packets.send(payload)
         packets.send(b"\xfe" + struct.pack("<HH", 0, 2))  # EOF

@@ -282,6 +282,26 @@ def test_grpc_server():
         assert ticks == [2, 1]
 
 
+def test_grpc_client_example():
+    """The client example drives the server example end-to-end: HTTP
+    in, gRPC out (unary + stream + health)."""
+    server_mod = load_example("grpc-server")
+    server_app = server_mod.build_app(cfg(GRPC_PORT="0"))
+    with AppRunner(app=server_app):
+        target = f"127.0.0.1:{server_app.grpc_server.bound_port}"
+        client_mod = load_example("grpc-client")
+        client_app = client_mod.build_app(cfg(), grpc_target=target)
+        with AppRunner(app=client_app) as front:
+            status, body = front.get_json("/hello?name=mesh")
+            assert status == 200
+            assert body["data"]["message"] == "Hello mesh!"
+            status, body = front.get_json("/countdown?from=2")
+            assert [m["t_minus"] for m in body["data"]["messages"]] \
+                == [2, 1]
+            status, body = front.get_json("/downstream-health")
+            assert body["data"]["status"] == "SERVING"
+
+
 def test_model_serving():
     mod = load_example("model-serving")
     with AppRunner(app=mod.build_app(cfg())) as runner:

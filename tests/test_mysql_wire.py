@@ -39,10 +39,9 @@ def test_query_roundtrip(db):
                   1, "ada", 2, "grace")
     assert res.rowcount == 2
     rows = db.query("SELECT id, name FROM t_my ORDER BY id")
-    # text protocol: values arrive as strings (like mysql's own text
-    # resultsets); NULLs are None
+    # typed decode from the column-definition type bytes
     assert [(r["id"], r["name"]) for r in rows] \
-        == [("1", "ada"), ("2", "grace")]
+        == [(1, "ada"), (2, "grace")]
     assert db.query_row("SELECT name FROM t_my WHERE id = ?", 2)["name"] \
         == "grace"
 
@@ -90,7 +89,7 @@ def test_error_packet_and_recovery(db):
     with pytest.raises(MySQLError) as exc:
         db.query("SELECT * FROM missing_table")
     assert exc.value.code == 1064 and exc.value.sqlstate == "42000"
-    assert db.query_row("SELECT 1 AS one")["one"] == "1"
+    assert db.query_row("SELECT 1 AS one")["one"] == 1
 
 
 def test_select_orm_lite_coerces(db):
