@@ -1,0 +1,196 @@
+"""Cross-cutting wire-client matrix: hostile strings round-trip
+byte-for-byte through every SQL-ish wire client (their literal
+escaping is the attack surface), and every instrumented client records
+into its latency histogram.
+"""
+
+import random
+
+import pytest
+
+NASTY = [
+    "plain",
+    "o'brien",
+    'double "quoted"',
+    "back\\slash",
+    "semi; DROP TABLE x; --",
+    "newline\nand\rreturn",
+    "tab\tand null-ish \\0",
+    "unicode ∆ 中文 émoji 🙂",
+    "$1 $2 ? ?? '?' {} %s",
+    "  leading and trailing  ",
+    "quote at end'",
+    "'", "''", '"', "`", "",
+]
+
+
+def _random_nasty(rng: random.Random, n: int) -> list[str]:
+    alphabet = "ab'\"\\\n\r\t;?$%{}()`∆é 中"
+    return ["".join(rng.choice(alphabet) for _ in range(rng.randint(1, 30)))
+            for _ in range(n)]
+
+
+ALL = NASTY + _random_nasty(random.Random(11), 40)
+
+
+def _roundtrip(db, values):
+    db.exec("CREATE TABLE fuzz (i INTEGER, v TEXT)")
+    for i, value in enumerate(values):
+        db.exec("INSERT INTO fuzz VALUES (?, ?)", i, value)
+    rows = db.query("SELECT i, v FROM fuzz ORDER BY i")
+    got = [r["v"] for r in rows]
+    assert got == values, [
+        (want, have) for want, have in zip(values, got) if want != have]
+
+
+def test_postgres_roundtrip_matrix():
+    from gofr_tpu.datasource.postgres_wire import (MiniPostgresServer,
+                                                   PostgresWire)
+    srv = MiniPostgresServer(auth="trust")
+    srv.start()
+    try:
+        db = PostgresWire(host="127.0.0.1", port=srv.port,
+                          user="postgres")
+        db.connect()
+        db.exec("CREATE TABLE fuzz (i INTEGER, v TEXT)")
+        for i, value in enumerate(ALL):
+            db.exec("INSERT INTO fuzz VALUES ($1, $2)", i, value)
+        got = [r["v"] for r in db.query("SELECT v FROM fuzz ORDER BY i")]
+        assert got == ALL
+        db.close()
+    finally:
+        srv.close()
+
+
+def test_mysql_roundtrip_matrix():
+    from gofr_tpu.datasource.mysql_wire import MiniMySQLServer, MySQLWire
+    srv = MiniMySQLServer(user="u", password="p")
+    srv.start()
+    try:
+        db = MySQLWire(host="127.0.0.1", port=srv.port, user="u",
+                       password="p")
+        db.connect()
+        _roundtrip(db, ALL)
+        db.close()
+    finally:
+        srv.close()
+
+
+def test_cassandra_roundtrip_matrix():
+    from gofr_tpu.datasource.cassandra_wire import (CassandraWire,
+                                                    MiniCassandraServer)
+    srv = MiniCassandraServer()
+    srv.start()
+    try:
+        db = CassandraWire(host="127.0.0.1", port=srv.port)
+        db.connect()
+        _roundtrip(db, ALL)
+        db.close()
+    finally:
+        srv.close()
+
+
+def test_clickhouse_roundtrip_matrix():
+    from gofr_tpu.datasource.clickhouse_wire import (ClickhouseWire,
+                                                     MiniClickhouseServer)
+    srv = MiniClickhouseServer()
+    srv.start()
+    try:
+        db = ClickhouseWire(endpoint=f"127.0.0.1:{srv.port}")
+        _roundtrip(db, ALL)
+    finally:
+        srv.close()
+
+
+@pytest.mark.parametrize("which", ["redis", "mongo", "dynamo"])
+def test_kv_document_roundtrip_matrix(which):
+    if which == "redis":
+        from gofr_tpu.datasource.redis_wire import (MiniRedisServer,
+                                                    RedisWire)
+        srv = MiniRedisServer()
+        srv.start()
+        client = RedisWire(host="127.0.0.1", port=srv.port)
+        client.connect()
+        try:
+            for i, value in enumerate(ALL):
+                client.set(f"k{i}", value)
+            for i, value in enumerate(ALL):
+                assert client.get(f"k{i}") == value
+        finally:
+            client.close()
+            srv.close()
+    elif which == "mongo":
+        from gofr_tpu.datasource.mongo_wire import (MiniMongoServer,
+                                                    MongoWire)
+        srv = MiniMongoServer()
+        srv.start()
+        client = MongoWire(host="127.0.0.1", port=srv.port)
+        client.connect()
+        try:
+            for i, value in enumerate(ALL):
+                client.insert_one("fuzz", {"i": i, "v": value})
+            for i, value in enumerate(ALL):
+                assert client.find_one("fuzz", {"i": i})["v"] == value
+        finally:
+            client.close()
+            srv.close()
+    else:
+        from gofr_tpu.datasource.dynamo_wire import (DynamoKV,
+                                                     MiniDynamoServer)
+        srv = MiniDynamoServer()
+        srv.start()
+        kv = DynamoKV(endpoint=f"127.0.0.1:{srv.port}", table="t",
+                      access_key="test", secret_key="secret")
+        try:
+            for i, value in enumerate(ALL):
+                kv.set(f"k{i}", value)
+            for i, value in enumerate(ALL):
+                assert kv.get(f"k{i}") == value
+        finally:
+            srv.close()
+
+
+def test_every_instrumented_wire_client_records_metrics():
+    """One op through each HTTP-ish wire client with a Manager attached
+    must populate that client's own histogram."""
+    from gofr_tpu.metrics.registry import Manager
+
+    from gofr_tpu.datasource.es_wire import ElasticsearchWire, MiniESServer
+    from gofr_tpu.datasource.solr_wire import MiniSolrServer, SolrWire
+    from gofr_tpu.datasource.opentsdb_wire import (MiniOpenTSDBServer,
+                                                   OpenTSDBWire)
+    from gofr_tpu.datasource.arango_wire import ArangoWire, MiniArangoServer
+
+    cases = []
+    es_srv = MiniESServer()
+    es_srv.start()
+    cases.append((ElasticsearchWire(endpoint=f"127.0.0.1:{es_srv.port}"),
+                  lambda c: c.index("i", "1", {"a": 1}),
+                  "app_elasticsearch_stats", es_srv))
+    solr_srv = MiniSolrServer()
+    solr_srv.start()
+    cases.append((SolrWire(endpoint=f"127.0.0.1:{solr_srv.port}"),
+                  lambda c: c.add("c", [{"id": "1"}]),
+                  "app_solr_stats", solr_srv))
+    tsdb_srv = MiniOpenTSDBServer()
+    tsdb_srv.start()
+    cases.append((OpenTSDBWire(endpoint=f"127.0.0.1:{tsdb_srv.port}"),
+                  lambda c: c.put_data_points(
+                      [{"metric": "m", "timestamp": 1, "value": 1.0}]),
+                  "app_opentsdb_stats", tsdb_srv))
+    arango_srv = MiniArangoServer()
+    arango_srv.start()
+    cases.append((ArangoWire(endpoint=f"127.0.0.1:{arango_srv.port}"),
+                  lambda c: c.create_document("c", {"a": 1}),
+                  "app_arangodb_stats", arango_srv))
+
+    try:
+        for client, op, metric, _srv in cases:
+            manager = Manager()
+            client.use_metrics(manager)
+            op(client)
+            scrape = manager.render_prometheus()
+            assert f"{metric}_count" in scrape, metric
+    finally:
+        for _, _, _, srv in cases:
+            srv.close()
