@@ -137,6 +137,7 @@ async def write_response(writer: asyncio.StreamWriter, response: ResponseData,
         headers["Transfer-Encoding"] = "chunked"
         writer.write(_render_head(response.status, headers))
         await writer.drain()
+        completed = False
         try:
             async for chunk in response.stream:
                 if isinstance(chunk, str):
@@ -148,11 +149,24 @@ async def write_response(writer: asyncio.StreamWriter, response: ResponseData,
                     continue
                 writer.write(f"{len(chunk):x}\r\n".encode() + bytes(chunk) + b"\r\n")
                 await writer.drain()
+            completed = True
         except Exception as exc:
             # Do NOT send the terminal chunk: the client must see the
             # truncation instead of mistaking a partial stream for a
             # complete response.
             raise StreamInterrupted(str(exc)) from exc
+        finally:
+            if not completed:
+                # close the iterator NOW — on errors AND cancellation
+                # (server shutdown) — so stream producers (the serving
+                # engine) cancel their work instead of waiting for
+                # garbage collection
+                closer = getattr(response.stream, "aclose", None)
+                if closer is not None:
+                    try:
+                        await closer()
+                    except BaseException:  # never mask the original
+                        pass
         writer.write(b"0\r\n\r\n")
         await writer.drain()
         return

@@ -69,6 +69,7 @@ class GenRequest:
     out_queue: Any = None          # asyncio.Queue[int | None]
     loop: Any = None               # the submitting event loop
     error: str | None = None
+    cancelled: bool = False        # consumer gone: retire, don't decode
     admit_order: int = -1          # paged preemption picks the newest;
                                    # assigned once at first admission and
                                    # kept across preemption-requeues so a
@@ -408,15 +409,26 @@ class Engine:
             time.sleep(0.002)
         return req
 
+    def cancel(self, req: GenRequest) -> None:
+        """Abandon a request: a disconnected client must not keep
+        burning decode slots. Waiting requests are dropped at
+        admission; active slots retire at the next pass."""
+        req.cancelled = True
+
     async def generate_stream(self, prompt_tokens: list[int],
                               params: SamplingParams | None = None):
-        """Async iterator of token ids."""
+        """Async iterator of token ids. Closing the iterator early
+        (client disconnect mid-stream) cancels the request."""
         req = self.submit(prompt_tokens, params)
-        while True:
-            token = await req.out_queue.get()
-            if token is None:
-                break
-            yield token
+        try:
+            while True:
+                token = await req.out_queue.get()
+                if token is None:
+                    break
+                yield token
+        finally:
+            if req.finished_at is None:
+                self.cancel(req)
 
     # ---------------------------------------------------------- scheduling
     def _group_sizes(self) -> tuple:
@@ -704,7 +716,8 @@ class Engine:
         # the tokens whose cache writes landed (see valid below) — the
         # cache ceiling truncates nothing anymore
         for i, req in enumerate(self.active):
-            if req is not None and self.lengths[i] >= cfg.max_seq:
+            if req is not None and (req.cancelled
+                                    or self.lengths[i] >= cfg.max_seq):
                 self._retire(i)
         if paged:
             # grow each slot's block table to cover this pass, evicting
@@ -789,7 +802,15 @@ class Engine:
                         free, first_wait_s=0.0 if busy else 0.05,
                         drain_wait_s=0.0)
                     if batch:
-                        self._admit_batch(batch)
+                        live = []
+                        for r in batch:
+                            if r.cancelled:  # dropped before prefill
+                                r.finished_at = time.time()
+                                r._emit(None)
+                            else:
+                                live.append(r)
+                        if live:
+                            self._admit_batch(live)
                 if any(r is not None for r in self.active):
                     self._decode_step()
         except Exception as exc:  # containment: never die silently

@@ -52,15 +52,23 @@ def make_chat_service(engine: Engine, tokenizer: Any) -> GRPCService:
             prompt_tokens = tokenizer.encode(prompt)
             start = time.perf_counter()
             n = 0
-            async for token in engine.generate_stream(prompt_tokens,
-                                                      params):
-                n += 1
-                yield {"token": token, "text": tokenizer.decode([token])}
-            yield {"done": True,
-                   "usage": {"prompt_tokens": len(prompt_tokens),
-                             "completion_tokens": n,
-                             "duration_ms": round(
-                                 (time.perf_counter() - start) * 1e3, 2)}}
+            gen = engine.generate_stream(prompt_tokens, params)
+            try:
+                async for token in gen:
+                    n += 1
+                    yield {"token": token,
+                           "text": tokenizer.decode([token])}
+                yield {"done": True,
+                       "usage": {"prompt_tokens": len(prompt_tokens),
+                                 "completion_tokens": n,
+                                 "duration_ms": round(
+                                     (time.perf_counter() - start) * 1e3,
+                                     2)}}
+            finally:
+                # a cancelled gRPC stream (client went away) must close
+                # the engine stream NOW so the request stops decoding —
+                # same contract as the HTTP SSE path
+                await gen.aclose()
 
         @rpc
         async def Complete(self, ctx, request) -> dict:

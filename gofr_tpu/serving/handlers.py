@@ -48,11 +48,20 @@ def make_chat_handler(engine: Engine, tokenizer: Any):
 
         if stream:
             async def sse():
-                async for token in engine.generate_stream(prompt_tokens, params):
-                    text = tokenizer.decode([token])
-                    yield ("data: " + json.dumps({"token": token, "text": text})
-                           + "\n\n")
-                yield "data: [DONE]\n\n"
+                gen = engine.generate_stream(prompt_tokens, params)
+                try:
+                    async for token in gen:
+                        text = tokenizer.decode([token])
+                        yield ("data: "
+                               + json.dumps({"token": token, "text": text})
+                               + "\n\n")
+                    yield "data: [DONE]\n\n"
+                finally:
+                    # deterministic: closing THIS generator (client
+                    # gone) must close the engine stream too, which
+                    # cancels the request instead of decoding to a
+                    # dead socket
+                    await gen.aclose()
             return Stream(sse())
 
         req = engine.submit(prompt_tokens, params)

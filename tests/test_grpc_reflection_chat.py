@@ -180,3 +180,46 @@ def test_grpc_chat_unary_complete_matches_stream():
             await channel.close()
         run(go())
     r.app._test_engine.stop()
+
+
+def test_grpc_stream_client_cancel_cancels_request():
+    """Cancelling a gRPC stream mid-generation must retire the engine
+    request promptly — same contract as the HTTP SSE disconnect."""
+    import time as _time
+
+    with AppRunner(build=_build_chat, config={"GRPC_PORT": "0"}) as r:
+        port = r.app.grpc_server.bound_port
+        engine = r.app._test_engine
+
+        async def go():
+            channel = grpc_lib.aio.insecure_channel(f"127.0.0.1:{port}")
+            method = channel.unary_stream(
+                "/gofr.serving.Chat/Stream",
+                request_serializer=lambda o: json.dumps(o).encode(),
+                response_deserializer=lambda b: json.loads(b))
+            call = method({"prompt": "abandon me", "max_tokens": 4096,
+                           "temperature": 0.0})
+            got = 0
+            async for event in call:
+                if "token" in event:
+                    got += 1
+                if got >= 2:  # generation is live — walk away
+                    break
+            abandoned = next(
+                (req for req in engine.active
+                 if req is not None
+                 and req.params.max_new_tokens == 4096), None)
+            call.cancel()
+            await channel.close()
+            return abandoned
+
+        abandoned = run(go())
+        assert abandoned is not None
+        deadline = _time.time() + 30
+        while _time.time() < deadline and abandoned.finished_at is None:
+            _time.sleep(0.05)
+        assert abandoned.finished_at is not None
+        assert abandoned.cancelled
+        # max_seq=64 would cap at ~50 generated; cancel stops well short
+        assert len(abandoned.generated) <= 32, len(abandoned.generated)
+    r.app._test_engine.stop()

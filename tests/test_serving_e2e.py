@@ -61,6 +61,46 @@ def test_chat_streaming_sse(serving_app):
     assert all("token" in e for e in token_events)
 
 
+def test_stream_client_disconnect_cancels_request(serving_app):
+    """A client that vanishes mid-SSE must not keep its slot decoding
+    to a dead socket: the engine cancels the request and stays healthy
+    for everyone else (reference stance: one bad client never degrades
+    the server)."""
+    import http.client
+    import time as _time
+
+    engine = serving_app.app.container.get_model("chat")
+    conn = http.client.HTTPConnection("127.0.0.1", serving_app.port,
+                                      timeout=10)
+    body = json.dumps({"prompt": "never-ending story", "stream": True,
+                       "temperature": 0.0, "max_tokens": 4096})
+    conn.request("POST", "/chat", body=body,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    resp.read(64)   # a few streamed bytes prove generation started
+    # hold a reference to the live request before walking away
+    abandoned = next(r for r in engine.active
+                     if r is not None and r.params.max_new_tokens == 4096)
+    conn.close()    # ...and the client vanishes
+
+    deadline = _time.time() + 30
+    while _time.time() < deadline and abandoned.finished_at is None:
+        _time.sleep(0.05)
+    assert abandoned.finished_at is not None, \
+        "abandoned stream still holds a slot"
+    # CANCELLED, not run-to-ceiling: the max_seq=128 cache would allow
+    # ~110 generated tokens — cancellation must stop far earlier
+    assert abandoned.cancelled
+    assert len(abandoned.generated) <= 48, len(abandoned.generated)
+
+    # and the engine keeps serving others
+    status, _, data = serving_app.request(
+        "POST", "/chat", {"prompt": "hi", "max_tokens": 3,
+                          "temperature": 0.0})
+    assert status == 201
+    assert json.loads(data)["data"]["usage"]["completion_tokens"] == 3
+
+
 def test_chat_missing_prompt(serving_app):
     status, _, data = serving_app.request("POST", "/chat", {"nope": 1})
     assert status == 400
