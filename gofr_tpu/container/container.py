@@ -55,6 +55,7 @@ class Container:
         self.file: Any = None                # file store
         self.ws_manager: Any = None          # websocket connection manager
         self.ws_services: dict[str, Any] = {}  # name -> outbound WSService
+        self.extra_health: dict[str, Any] = {}  # name -> health_check()able
         # breadth datasource slots (reference container.go:43-75 holds one
         # field per store); _BREADTH_SLOTS is the single definition site —
         # it also drives the generated add_* methods, health() and close()
@@ -232,6 +233,9 @@ class Container:
         for svc_name, svc in self.services.items():
             checks[f"service:{svc_name}"] = self._check_one(svc)
             statuses.append(checks[f"service:{svc_name}"].get("status", STATUS_DOWN))
+        for extra_name, source in self.extra_health.items():
+            checks[extra_name] = self._check_one(source)
+            statuses.append(checks[extra_name].get("status", STATUS_DOWN))
         status = STATUS_UP
         if any(s != STATUS_UP for s in statuses):
             status = STATUS_DEGRADED
@@ -320,6 +324,11 @@ class Container:
 
     def register_service(self, name: str, service: Any) -> None:
         self.services[name] = service
+
+    def register_health_check(self, name: str, source: Any) -> None:
+        """Attach any extra ``health_check()``-bearing component (e.g.
+        the serving control plane) to the aggregate health surface."""
+        self.extra_health[name] = source
 
     def register_ws_service(self, name: str, service: Any) -> None:
         self.ws_services[name] = service

@@ -1,0 +1,379 @@
+"""Multi-host serving control plane: join, shard assignment, health
+gossip, failure detection, elastic regeneration.
+
+SURVEY §7 stage 8's host-coordination layer. On TPU pods the *data
+plane* is a single SPMD program — XLA collectives over ICI move the
+tensors, and ``jax.distributed`` launches every host into one runtime
+(see :func:`ShardAssignment.jax_initialize_args`). What that runtime
+does NOT provide is the service-level lifecycle around it: who is in
+the serving group, which process is which rank, how a dead host is
+detected, and how survivors agree to relaunch. The reference's analog
+is its service client + gRPC control plane
+(/root/reference/pkg/gofr/service/new.go:68, grpc.go:89); this module
+plays that role with the framework's own building blocks — the leader
+is a set of HTTP routes on an :class:`~gofr_tpu.app.App`, workers dial
+it through :func:`~gofr_tpu.service.new_http_service` (circuit
+breaker + retry included).
+
+Protocol (all JSON over the framework's HTTP):
+
+- ``POST /control/join`` {host_id, address, n_devices, health?}
+  -> {generation, assignment} and bumps the generation: membership
+  changed, every host must re-coordinate.
+- ``POST /control/heartbeat`` {host_id, generation, health?}
+  -> {ok, generation, assignment} — a worker heartbeating with a stale
+  generation learns its new assignment right there (elastic restart:
+  ranks are contiguous again after an eviction or a join).
+- ``GET /control/topology`` -> members, assignments, gossiped health —
+  also surfaced through the leader app's health endpoint.
+
+Failure detection: the leader sweeps heartbeat deadlines; a host that
+misses ``eviction_misses`` intervals is evicted and the generation
+bumps. Workers detect leader loss through the service client's circuit
+breaker and keep retrying with backoff.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ..http.errors import ErrorInvalidParam, HTTPError
+
+
+class StaleGeneration(HTTPError):
+    """Worker raced a membership change; body carries the fresh view."""
+
+    status_code = 409
+
+
+@dataclass
+class ShardAssignment:
+    """One host's slice of the serving group."""
+
+    host_id: str
+    rank: int
+    world_size: int
+    n_devices: int
+    generation: int
+    coordinator: str  # host:port every jax.distributed process dials
+
+    def jax_initialize_args(self) -> dict[str, Any]:
+        """kwargs for ``jax.distributed.initialize`` — the hand-off
+        point from control plane to SPMD data plane."""
+        return {"coordinator_address": self.coordinator,
+                "num_processes": self.world_size,
+                "process_id": self.rank}
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"host_id": self.host_id, "rank": self.rank,
+                "world_size": self.world_size,
+                "n_devices": self.n_devices,
+                "generation": self.generation,
+                "coordinator": self.coordinator}
+
+
+@dataclass
+class _Member:
+    host_id: str
+    address: str
+    n_devices: int
+    last_seen: float
+    health: dict = field(default_factory=dict)
+
+
+class ControlPlaneLeader:
+    """Leader state + the routes that expose it. Attach to any App:
+
+    >>> leader = ControlPlaneLeader(coordinator="10.0.0.1:8476")
+    >>> leader.install(app)        # POST /control/join, /control/heartbeat
+    """
+
+    def __init__(self, *, coordinator: str = "",
+                 heartbeat_interval_s: float = 2.0,
+                 eviction_misses: int = 3,
+                 logger: Any = None) -> None:
+        self.coordinator = coordinator
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.eviction_misses = eviction_misses
+        self.logger = logger
+        self.generation = 0
+        self._members: dict[str, _Member] = {}
+        self._lock = threading.Lock()
+        self._sweeper: threading.Thread | None = None
+        self._running = False
+
+    # ------------------------------------------------------------ state
+    def _assignment_locked(self, host_id: str) -> ShardAssignment:
+        # deterministic contiguous ranks: sort by host_id so every
+        # caller computes the same mapping for a given membership
+        ordered = sorted(self._members)
+        return ShardAssignment(
+            host_id=host_id, rank=ordered.index(host_id),
+            world_size=len(ordered),
+            n_devices=self._members[host_id].n_devices,
+            generation=self.generation, coordinator=self.coordinator)
+
+    def join(self, host_id: str, address: str, n_devices: int,
+             health: dict | None = None) -> ShardAssignment:
+        if not host_id:
+            raise ErrorInvalidParam("host_id")
+        with self._lock:
+            self.generation += 1  # membership changed for everyone
+            self._members[host_id] = _Member(
+                host_id=host_id, address=address,
+                n_devices=max(1, int(n_devices)),
+                last_seen=time.time(), health=dict(health or {}))
+            assignment = self._assignment_locked(host_id)
+        if self.logger:
+            self.logger.info(
+                "host joined serving group", host=host_id,
+                rank=assignment.rank, world=assignment.world_size,
+                generation=self.generation)
+        return assignment
+
+    def heartbeat(self, host_id: str, generation: int,
+                  health: dict | None = None
+                  ) -> tuple[ShardAssignment, bool]:
+        """-> (assignment, changed): ``changed`` is True when the
+        worker's view was stale — its signal to re-coordinate."""
+        with self._lock:
+            member = self._members.get(host_id)
+            if member is None:
+                raise StaleGeneration(
+                    "unknown host: rejoin required", status_code=409)
+            member.last_seen = time.time()
+            if health is not None:
+                member.health = dict(health)
+            return (self._assignment_locked(host_id),
+                    generation != self.generation)
+
+    def evict(self, host_id: str) -> None:
+        with self._lock:
+            if self._members.pop(host_id, None) is None:
+                return
+            self.generation += 1
+        if self.logger:
+            self.logger.warn("host evicted from serving group",
+                             host=host_id, generation=self.generation)
+
+    def topology(self) -> dict[str, Any]:
+        with self._lock:
+            ranks = {h: i for i, h in enumerate(sorted(self._members))}
+            return {
+                "generation": self.generation,
+                "world_size": len(self._members),
+                "members": {
+                    m.host_id: {"address": m.address,
+                                "n_devices": m.n_devices,
+                                "rank": ranks[m.host_id],
+                                "last_seen": m.last_seen,
+                                "health": m.health}
+                    for m in self._members.values()},
+            }
+
+    def health_check(self) -> dict[str, Any]:
+        topo = self.topology()
+        degraded = [h for h, m in topo["members"].items()
+                    if m["health"].get("status") not in (None, "UP")]
+        status = "UP" if not degraded else "DEGRADED"
+        return {"status": status,
+                "details": {"generation": topo["generation"],
+                            "world_size": topo["world_size"],
+                            "degraded_hosts": degraded}}
+
+    # ---------------------------------------------------------- sweeper
+    def _sweep_once(self) -> None:
+        deadline = time.time() - (self.heartbeat_interval_s
+                                  * self.eviction_misses)
+        dead = [h for h, m in list(self._members.items())
+                if m.last_seen < deadline]
+        for host_id in dead:
+            self.evict(host_id)
+
+    def start(self) -> None:
+        self._running = True
+
+        def run() -> None:
+            while self._running:
+                self._sweep_once()
+                time.sleep(self.heartbeat_interval_s / 2)
+
+        self._sweeper = threading.Thread(target=run, daemon=True,
+                                         name="control-plane-sweeper")
+        self._sweeper.start()
+
+    def stop(self) -> None:
+        self._running = False
+
+    # ------------------------------------------------------------ routes
+    def install(self, app: Any) -> None:
+        """Register the control routes and start the sweeper when the
+        app starts (reference startup-hook pattern, gofr.go:359)."""
+
+        @app.post("/control/join")
+        def join(ctx):
+            body = ctx.bind() or {}
+            assignment = self.join(
+                str(body.get("host_id", "")),
+                str(body.get("address", "")),
+                int(body.get("n_devices", 1)),
+                body.get("health"))
+            # the assignment's generation, not a re-read of
+            # self.generation: a concurrent join may have bumped it
+            return {"generation": assignment.generation,
+                    "assignment": assignment.to_dict()}
+
+        @app.post("/control/heartbeat")
+        def heartbeat(ctx):
+            body = ctx.bind() or {}
+            assignment, changed = self.heartbeat(
+                str(body.get("host_id", "")),
+                int(body.get("generation", -1)),
+                body.get("health"))
+            return {"ok": True, "changed": changed,
+                    "generation": assignment.generation,
+                    "assignment": assignment.to_dict()}
+
+        @app.get("/control/topology")
+        def topology(ctx):
+            return self.topology()
+
+        app.container.register_health_check("control_plane", self)
+
+        @app.on_start
+        def _start_sweeper():
+            self.start()
+
+        app.on_shutdown(self.stop)
+
+
+class WorkerAgent:
+    """A serving host's side of the protocol: join once, heartbeat on a
+    thread, and invoke ``on_assignment`` every time the generation
+    changes — the hook where the host tears down and relaunches its
+    SPMD program with the new rank/world (elastic restart)."""
+
+    def __init__(self, leader_url: str, *, host_id: str,
+                 address: str = "", n_devices: int = 1,
+                 heartbeat_interval_s: float = 2.0,
+                 on_assignment: Callable[[ShardAssignment], None]
+                 | None = None,
+                 health_source: Callable[[], dict] | None = None,
+                 logger: Any = None, service: Any = None) -> None:
+        from ..service import CircuitBreaker, Retry, new_http_service
+        self.host_id = host_id
+        self.address = address
+        self.n_devices = n_devices
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.on_assignment = on_assignment
+        self.health_source = health_source or (lambda: {"status": "UP"})
+        self.logger = logger
+        self._service = service if service is not None else \
+            new_http_service(leader_url, Retry(max_retries=2),
+                             CircuitBreaker(threshold=5, interval_s=2.0),
+                             logger=logger)
+        self.assignment: ShardAssignment | None = None
+        self._running = False
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------- wire
+    def _post(self, path: str, body: dict) -> dict:
+        import asyncio
+        # the heartbeat thread is sync; the service client (circuit
+        # breaker, retry, tracing) is async — one loop per call is
+        # cheap at heartbeat cadence
+        response = asyncio.run(self._service.post(path, json=body))
+        if response.status == 409:
+            return {"rejoin": True}
+        if response.status >= 400:
+            raise RuntimeError(
+                f"control plane {path} -> {response.status}")
+        data = response.json()
+        return data.get("data", data)
+
+    def _apply(self, payload: dict) -> None:
+        raw = payload.get("assignment")
+        if raw is None:
+            return
+        new = ShardAssignment(
+            host_id=raw["host_id"], rank=int(raw["rank"]),
+            world_size=int(raw["world_size"]),
+            n_devices=int(raw["n_devices"]),
+            generation=int(raw["generation"]),
+            coordinator=raw.get("coordinator", ""))
+        old = self.assignment
+        self.assignment = new
+        if (old is None or old.generation != new.generation) \
+                and self.on_assignment is not None:
+            self.on_assignment(new)
+
+    def join(self) -> ShardAssignment:
+        payload = self._post("/control/join", {
+            "host_id": self.host_id, "address": self.address,
+            "n_devices": self.n_devices,
+            "health": self.health_source()})
+        self._apply(payload)
+        assert self.assignment is not None
+        return self.assignment
+
+    def _heartbeat_once(self) -> None:
+        generation = (self.assignment.generation
+                      if self.assignment is not None else -1)
+        try:
+            payload = self._post("/control/heartbeat", {
+                "host_id": self.host_id, "generation": generation,
+                "health": self.health_source()})
+        except Exception as exc:
+            # leader unreachable: the circuit breaker is already
+            # backing off — keep the last assignment and keep serving
+            if self.logger:
+                self.logger.warn(f"control-plane heartbeat failed: {exc}")
+            return
+        if payload.get("rejoin"):
+            try:
+                self.join()
+            except Exception as exc:
+                if self.logger:
+                    self.logger.warn(f"rejoin failed: {exc}")
+            return
+        self._apply(payload)
+
+    def start(self) -> None:
+        """Begin joining + heartbeating. A leader that is not up yet
+        must not be fatal (rolling restarts bring workers up first):
+        the thread keeps retrying the join with backoff until it
+        lands, then heartbeats."""
+        self._running = True
+        try:
+            self.join()
+        except Exception as exc:
+            if self.logger:
+                self.logger.warn(
+                    f"control-plane join failed, will retry: {exc}")
+
+        def run() -> None:
+            while self._running:
+                time.sleep(self.heartbeat_interval_s)
+                if not self._running:
+                    return
+                if self.assignment is None:
+                    try:
+                        self.join()
+                    except Exception as exc:
+                        if self.logger:
+                            self.logger.warn(f"join retry failed: {exc}")
+                else:
+                    self._heartbeat_once()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name=f"worker-{self.host_id}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(self.heartbeat_interval_s * 2 + 1)
+            self._thread = None

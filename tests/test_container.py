@@ -1,0 +1,128 @@
+"""DI container unit tests: env-driven create, provider wiring,
+health aggregation, generated adders, mock container.
+
+(reference container/container.go:77-177, health.go:8-98,
+mock_container.go:93)
+"""
+
+from gofr_tpu.config import DictConfig
+from gofr_tpu.container.container import Container
+from gofr_tpu.container.mock import MockContainer
+
+
+def test_create_wires_sql_and_defaults_from_env():
+    c = Container.create(DictConfig({
+        "APP_NAME": "svc", "APP_VERSION": "1.2.3",
+        "DB_DIALECT": "sqlite", "DB_NAME": ":memory:"}))
+    assert c.app_name == "svc" and c.app_version == "1.2.3"
+    assert c.sql is not None
+    assert c.sql.query_row("SELECT 1 AS one")["one"] == 1
+    assert c.pubsub is None  # not configured stays None
+
+
+def test_unconfigured_create_still_boots():
+    c = Container.create(DictConfig({}))
+    assert c.sql is None
+    health = c.health()
+    assert health["status"] in ("UP", "DEGRADED")
+    assert health["details"]["name"] == "gofr-app"
+
+
+def test_provider_pattern_wires_logger_metrics_tracer():
+    c = Container.create(DictConfig({}))
+    seen = {}
+
+    class Store:
+        def use_logger(self, logger):
+            seen["logger"] = logger
+
+        def use_metrics(self, metrics):
+            seen["metrics"] = metrics
+
+        def use_tracer(self, tracer):
+            seen["tracer"] = tracer
+
+        def connect(self):
+            seen["connected"] = True
+
+        def health_check(self):
+            return {"status": "UP"}
+
+    c.add_mongo(Store())
+    assert seen == {"logger": c.logger, "metrics": c.metrics,
+                    "tracer": c.tracer, "connected": True}
+    assert c.mongo is not None
+
+
+def test_generated_adders_cover_every_breadth_slot():
+    from gofr_tpu.container.container import _BREADTH_SLOTS
+    c = Container.create(DictConfig({}))
+    for slot in _BREADTH_SLOTS:
+        assert callable(getattr(c, f"add_{slot}")), slot
+        assert hasattr(c, slot)
+
+
+def test_health_aggregates_down_slot_to_degraded():
+    c = Container.create(DictConfig({}))
+
+    class Sick:
+        def connect(self):
+            pass
+
+        def health_check(self):
+            return {"status": "DOWN", "error": "gone"}
+
+    c.add_cassandra(Sick())
+    health = c.health()
+    assert health["status"] == "DEGRADED"
+    assert health["checks"]["cassandra"]["status"] == "DOWN"
+
+
+def test_health_includes_extra_health_checks():
+    c = Container.create(DictConfig({}))
+
+    class Extra:
+        def health_check(self):
+            return {"status": "DEGRADED", "details": {"n": 2}}
+
+    c.register_health_check("control_plane", Extra())
+    health = c.health()
+    assert health["checks"]["control_plane"]["status"] == "DEGRADED"
+    assert health["status"] == "DEGRADED"
+
+
+def test_health_check_exception_reads_as_down():
+    c = Container.create(DictConfig({}))
+
+    class Broken:
+        def connect(self):
+            pass
+
+        def health_check(self):
+            raise RuntimeError("probe exploded")
+
+    c.add_solr(Broken())
+    health = c.health()
+    assert health["checks"]["solr"]["status"] == "DOWN"
+    assert health["status"] == "DEGRADED"
+
+
+def test_mock_container_records_calls_and_results():
+    mock = MockContainer()
+    mock.mock("sql").expect("query_row", result={"n": 7})
+    assert mock.sql.query_row("SELECT n FROM t WHERE id = ?", 1) \
+        == {"n": 7}
+    calls = mock.mock("sql").calls_to("query_row")
+    assert calls == [(("SELECT n FROM t WHERE id = ?", 1), {})]
+
+
+def test_models_registry():
+    c = Container.create(DictConfig({}))
+
+    class Engine:
+        pass
+
+    engine = Engine()
+    c.add_model("chat", engine)
+    assert c.get_model("chat") is engine
+    assert c.get_model("absent") is None

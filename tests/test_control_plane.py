@@ -1,0 +1,166 @@
+"""Multi-host serving control plane over real HTTP: join/heartbeat/
+topology routes on a framework App, worker agents on the service
+client, failure detection, elastic rank reassignment."""
+
+import time
+
+import pytest
+
+from gofr_tpu.serving.control_plane import (ControlPlaneLeader,
+                                            ShardAssignment, WorkerAgent)
+
+from .apputil import AppRunner
+
+
+def make_leader(**kw):
+    leader = ControlPlaneLeader(coordinator="10.0.0.1:8476", **kw)
+
+    def build(app):
+        leader.install(app)
+    return leader, build
+
+
+def agent(runner, host_id, **kw):
+    return WorkerAgent(f"http://127.0.0.1:{runner.port}",
+                       host_id=host_id, n_devices=4,
+                       heartbeat_interval_s=0.1, **kw)
+
+
+def test_join_assigns_contiguous_ranks_sorted_by_host_id():
+    leader, build = make_leader()
+    with AppRunner(build=build) as runner:
+        c = agent(runner, "host-c")
+        a = agent(runner, "host-a")
+        b = agent(runner, "host-b")
+        c.join()
+        a.join()
+        b.join()
+        # ranks follow sorted host ids, regardless of join order
+        assert (a.assignment.rank, b.assignment.rank) == (0, 1)
+        assert b.assignment.world_size == 3
+        # earlier joiners see their new rank at the next heartbeat
+        c._heartbeat_once()
+        assert c.assignment.rank == 2
+        assert c.assignment.world_size == 3
+        assert leader.generation == 3  # one bump per join
+
+
+def test_assignment_feeds_jax_distributed():
+    assignment = ShardAssignment(host_id="h", rank=1, world_size=4,
+                                 n_devices=4, generation=7,
+                                 coordinator="10.0.0.1:8476")
+    assert assignment.jax_initialize_args() == {
+        "coordinator_address": "10.0.0.1:8476",
+        "num_processes": 4, "process_id": 1}
+
+
+def test_generation_change_invokes_on_assignment():
+    leader, build = make_leader()
+    with AppRunner(build=build) as runner:
+        seen = []
+        a = agent(runner, "a", on_assignment=lambda s: seen.append(
+            (s.generation, s.rank, s.world_size)))
+        a.join()
+        assert seen == [(1, 0, 1)]
+        b = agent(runner, "b")
+        b.join()
+        a._heartbeat_once()      # same assignment, new generation
+        assert seen[-1] == (2, 0, 2)
+        a._heartbeat_once()      # no change: callback not re-invoked
+        assert len(seen) == 2
+
+
+def test_dead_host_is_evicted_and_ranks_close_up():
+    leader, build = make_leader(heartbeat_interval_s=0.1,
+                                eviction_misses=2)
+    with AppRunner(build=build) as runner:
+        a = agent(runner, "a")
+        b = agent(runner, "b")
+        a.start()                # heartbeats on a thread
+        b.join()                 # joins but never heartbeats: "dies"
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if leader.topology()["world_size"] == 1 \
+                    and a.assignment.world_size == 1:
+                break
+            time.sleep(0.05)
+        a.stop()
+        topo = leader.topology()
+        assert topo["world_size"] == 1 and "a" in topo["members"]
+        assert a.assignment.rank == 0 and a.assignment.world_size == 1
+
+
+def test_evicted_worker_rejoins_on_heartbeat():
+    leader, build = make_leader()
+    with AppRunner(build=build) as runner:
+        a = agent(runner, "a")
+        a.join()
+        leader.evict("a")
+        generation = leader.generation
+        a._heartbeat_once()      # 409 -> automatic rejoin
+        assert leader.topology()["world_size"] == 1
+        assert a.assignment.generation == generation + 1
+
+
+def test_health_gossip_aggregates_to_leader():
+    leader, build = make_leader()
+    with AppRunner(build=build) as runner:
+        healthy = agent(runner, "good",
+                        health_source=lambda: {"status": "UP"})
+        sick = agent(runner, "bad",
+                     health_source=lambda: {"status": "DOWN",
+                                            "error": "HBM ECC"})
+        healthy.join()
+        sick.join()
+        healthy._heartbeat_once()
+        sick._heartbeat_once()
+        topo = leader.topology()
+        assert topo["members"]["bad"]["health"]["error"] == "HBM ECC"
+        health = leader.health_check()
+        assert health["status"] == "DEGRADED"
+        assert health["details"]["degraded_hosts"] == ["bad"]
+
+
+def test_leader_health_rides_the_app_health_endpoint():
+    leader, build = make_leader()
+    with AppRunner(build=build) as runner:
+        sick = agent(runner, "bad",
+                     health_source=lambda: {"status": "DOWN"})
+        sick.join()
+        status, body = runner.get_json("/.well-known/health")
+        checks = body["data"]["checks"]
+        assert checks["control_plane"]["status"] == "DEGRADED"
+        assert body["data"]["status"] == "DEGRADED"
+
+
+def test_worker_survives_leader_down_at_start():
+    """start() before the leader exists must retry, not die."""
+    worker = WorkerAgent("http://127.0.0.1:1", host_id="early",
+                         heartbeat_interval_s=0.1)
+    worker.start()                      # leader unreachable: no raise
+    try:
+        assert worker.assignment is None
+        leader, build = make_leader()
+        with AppRunner(build=build) as runner:
+            # point the (already running) agent at the live leader
+            from gofr_tpu.service import new_http_service
+            worker._service = new_http_service(
+                f"http://127.0.0.1:{runner.port}")
+            deadline = time.time() + 5
+            while time.time() < deadline and worker.assignment is None:
+                time.sleep(0.05)
+            assert worker.assignment is not None
+            assert worker.assignment.rank == 0
+    finally:
+        worker.stop()
+
+
+def test_topology_route_over_http():
+    leader, build = make_leader()
+    with AppRunner(build=build) as runner:
+        agent(runner, "x").join()
+        status, body = runner.get_json("/control/topology")
+        assert status == 200
+        topo = body["data"]
+        assert topo["world_size"] == 1
+        assert topo["members"]["x"]["rank"] == 0
