@@ -135,10 +135,13 @@ async def write_response(writer: asyncio.StreamWriter, response: ResponseData,
         headers.setdefault("Content-Type", response.content_type)
         headers.setdefault("Cache-Control", "no-cache")
         headers["Transfer-Encoding"] = "chunked"
-        writer.write(_render_head(response.status, headers))
-        await writer.drain()
         completed = False
         try:
+            # the head write sits INSIDE the try: a client that is
+            # already gone fails right here, and the finally must
+            # still close the producer
+            writer.write(_render_head(response.status, headers))
+            await writer.drain()
             async for chunk in response.stream:
                 if isinstance(chunk, str):
                     chunk = chunk.encode()

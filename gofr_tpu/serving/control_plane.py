@@ -44,7 +44,8 @@ from ..http.errors import ErrorInvalidParam, HTTPError
 
 
 class StaleGeneration(HTTPError):
-    """Worker raced a membership change; body carries the fresh view."""
+    """The leader no longer knows this host: a 409 telling the worker
+    to rejoin (which returns the fresh assignment)."""
 
     status_code = 409
 
@@ -106,13 +107,17 @@ class ControlPlaneLeader:
         self._running = False
 
     # ------------------------------------------------------------ state
+    def _ranks_locked(self) -> dict[str, int]:
+        """THE rank mapping: deterministic contiguous ranks sorted by
+        host_id, so every caller computes the same view for a given
+        membership. Both assignments and topology derive from here."""
+        return {h: i for i, h in enumerate(sorted(self._members))}
+
     def _assignment_locked(self, host_id: str) -> ShardAssignment:
-        # deterministic contiguous ranks: sort by host_id so every
-        # caller computes the same mapping for a given membership
-        ordered = sorted(self._members)
+        ranks = self._ranks_locked()
         return ShardAssignment(
-            host_id=host_id, rank=ordered.index(host_id),
-            world_size=len(ordered),
+            host_id=host_id, rank=ranks[host_id],
+            world_size=len(ranks),
             n_devices=self._members[host_id].n_devices,
             generation=self.generation, coordinator=self.coordinator)
 
@@ -142,8 +147,7 @@ class ControlPlaneLeader:
         with self._lock:
             member = self._members.get(host_id)
             if member is None:
-                raise StaleGeneration(
-                    "unknown host: rejoin required", status_code=409)
+                raise StaleGeneration("unknown host: rejoin required")
             member.last_seen = time.time()
             if health is not None:
                 member.health = dict(health)
@@ -161,7 +165,7 @@ class ControlPlaneLeader:
 
     def topology(self) -> dict[str, Any]:
         with self._lock:
-            ranks = {h: i for i, h in enumerate(sorted(self._members))}
+            ranks = self._ranks_locked()
             return {
                 "generation": self.generation,
                 "world_size": len(self._members),
@@ -188,8 +192,9 @@ class ControlPlaneLeader:
     def _sweep_once(self) -> None:
         deadline = time.time() - (self.heartbeat_interval_s
                                   * self.eviction_misses)
-        dead = [h for h, m in list(self._members.items())
-                if m.last_seen < deadline]
+        with self._lock:  # joins mutate _members concurrently
+            dead = [h for h, m in self._members.items()
+                    if m.last_seen < deadline]
         for host_id in dead:
             self.evict(host_id)
 

@@ -116,6 +116,12 @@ class EngineConfig:
     #: engines started in the same millisecond never share streams. Set
     #: for reproducible generation in tests/evals.
     seed: int | None = None
+    #: admission bound: waiting requests beyond this fail immediately
+    #: with "engine overloaded" (surfaced as a 503 by the handlers)
+    #: instead of growing an unbounded queue where every TTFT degrades
+    #: together. 0 = unbounded. Already-admitted work that bounces
+    #: back (preemption, slot races) bypasses the bound.
+    max_waiting: int = 0
     #: "slot" = contiguous per-slot rows (max_batch x max_seq, simplest
     #: and fastest per step); "paged" = block-table indirection over a
     #: page pool (ops/paged_kv.py) — capacity decoupled from
@@ -250,7 +256,11 @@ class Engine:
         # admission queue: C++ waitable batch queue when a toolchain
         # exists (gofr_tpu/native), queue.Queue-semantics fallback
         from ..native.batch_queue import new_request_queue
-        self.waiting = new_request_queue()
+        self.waiting = new_request_queue(config.max_waiting)
+        # already-admitted work bounced back (preemption, slot races):
+        # re-enters ahead of the public queue and NEVER counts against
+        # the admission bound — engine-thread only, no lock needed
+        self._requeued: list[GenRequest] = []
 
         self._rng_step = 0
         self._running = False
@@ -312,6 +322,9 @@ class Engine:
         self.waiting.close()
         stranded = self.waiting.pop_batch(1 << 16, first_wait_s=0.0)
         for req in stranded or []:
+            self._fail(req, reason)
+        requeued, self._requeued = self._requeued, []
+        for req in requeued:
             self._fail(req, reason)
         for i, req in enumerate(self.active):
             if req is not None:
@@ -398,7 +411,9 @@ class Engine:
             req.loop = None
             req.out_queue = None
         if not self.waiting.put(req):  # full/closed: fail loudly, never hang
-            self._fail(req, "engine not accepting requests")
+            self._fail(req, "engine overloaded: waiting queue full"
+                       if self._running else
+                       "engine not accepting requests")
         return req
 
     def submit_sync(self, prompt_tokens: list[int],
@@ -415,11 +430,9 @@ class Engine:
         admission; active slots retire at the next pass."""
         req.cancelled = True
 
-    async def generate_stream(self, prompt_tokens: list[int],
-                              params: SamplingParams | None = None):
-        """Async iterator of token ids. Closing the iterator early
-        (client disconnect mid-stream) cancels the request."""
-        req = self.submit(prompt_tokens, params)
+    async def stream_request(self, req: GenRequest):
+        """Async iterator of a submitted request's token ids. Closing
+        the iterator early (client disconnect) cancels the request."""
         try:
             while True:
                 token = await req.out_queue.get()
@@ -429,6 +442,15 @@ class Engine:
         finally:
             if req.finished_at is None:
                 self.cancel(req)
+
+    async def generate_stream(self, prompt_tokens: list[int],
+                              params: SamplingParams | None = None):
+        """Submit + stream in one call (raises nothing on overload —
+        the stream just ends; handlers that need a 503 submit first
+        and check ``req.error``)."""
+        req = self.submit(prompt_tokens, params)
+        async for token in self.stream_request(req):
+            yield token
 
     # ---------------------------------------------------------- scheduling
     def _group_sizes(self) -> tuple:
@@ -543,8 +565,7 @@ class Engine:
         limit = min(max(self._usable_buckets), self.config.max_seq)
         if len(req.prompt_tokens) > limit:
             req.prompt_tokens = req.prompt_tokens[-limit:]
-        if not self.waiting.put(req):
-            self._fail(req, "engine not accepting requests")
+        self._requeued.append(req)
 
     def _ensure_headroom(self, slot: int, rows: int) -> bool:
         """Allocate pages for ``rows`` logical rows, preempting the
@@ -586,9 +607,8 @@ class Engine:
         placed: list[GenRequest] = []
         for req in chunk:
             slot = self._free_slot()
-            if slot < 0:  # raced out of slots; back to the queue
-                if not self.waiting.put(req):
-                    self._fail(req, "engine not accepting requests")
+            if slot < 0:  # raced out of slots; back to the requeue list
+                self._requeued.append(req)
                 continue
             if paged:
                 pg = cfg.page_size
@@ -599,8 +619,7 @@ class Engine:
                 if not self._alloc_pages(slot, len(req.prompt_tokens) + 1):
                     # pool busy: requeue and wait for retires to free
                     # pages
-                    if not self.waiting.put(req):
-                        self._fail(req, "engine not accepting requests")
+                    self._requeued.append(req)
                     continue
                 if req.admit_order < 0:
                     req.admit_order = self._admit_seq
@@ -794,13 +813,20 @@ class Engine:
                 free = sum(1 for r in self.active if r is None)
                 busy = free < self.config.max_batch
                 if free > 0:
-                    # one batched pop per pass (TTFT priority): blocks
-                    # while fully idle — in the native queue the engine
-                    # thread sleeps in C with the GIL released — and is
-                    # a zero-wait drain between decode steps while busy
-                    batch = self.waiting.pop_batch(
-                        free, first_wait_s=0.0 if busy else 0.05,
-                        drain_wait_s=0.0)
+                    # requeued (already-admitted) work goes first and
+                    # bypasses the admission bound; then one batched
+                    # pop per pass (TTFT priority): blocks while fully
+                    # idle — in the native queue the engine thread
+                    # sleeps in C with the GIL released — and is a
+                    # zero-wait drain between decode steps while busy
+                    batch, self._requeued = self._requeued, []
+                    take = free - len(batch)
+                    if take > 0:
+                        popped = self.waiting.pop_batch(
+                            take,
+                            first_wait_s=0.0 if (busy or batch) else 0.05,
+                            drain_wait_s=0.0)
+                        batch = batch + (popped or [])
                     if batch:
                         live = []
                         for r in batch:

@@ -12,7 +12,8 @@ import json
 import time
 from typing import Any
 
-from ..http.errors import ErrorInvalidParam, ErrorMissingParam
+from ..http.errors import (ErrorInvalidParam, ErrorMissingParam,
+                           ErrorServiceUnavailable)
 from ..http.response import Raw, Stream
 from .engine import Engine, SamplingParams
 
@@ -46,9 +47,14 @@ def make_chat_handler(engine: Engine, tokenizer: Any):
         prompt_tokens = tokenizer.encode(prompt)
         stream = bool(body.get("stream", False))
 
+        req = engine.submit(prompt_tokens, params)
+        if req.error:
+            # instant failure = admission refused, not a generation bug
+            raise ErrorServiceUnavailable(req.error)
+
         if stream:
             async def sse():
-                gen = engine.generate_stream(prompt_tokens, params)
+                gen = engine.stream_request(req)
                 try:
                     async for token in gen:
                         text = tokenizer.decode([token])
@@ -64,7 +70,6 @@ def make_chat_handler(engine: Engine, tokenizer: Any):
                     await gen.aclose()
             return Stream(sse())
 
-        req = engine.submit(prompt_tokens, params)
         tokens: list[int] = []
         while True:
             token = await req.out_queue.get()

@@ -101,6 +101,35 @@ def test_stream_client_disconnect_cancels_request(serving_app):
     assert json.loads(data)["data"]["usage"]["completion_tokens"] == 3
 
 
+def test_overloaded_engine_returns_503():
+    """With max_waiting bounded, a flood beyond slots+queue gets an
+    immediate 503 instead of joining an ever-slower queue."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    tokenizer = ByteTokenizer()
+    engine = demo_llama_engine(
+        EngineConfig(max_batch=1, max_seq=64, max_waiting=1, seed=1))
+    engine.start()
+    try:
+        with AppRunner() as runner:
+            runner.app.post("/chat", make_chat_handler(engine, tokenizer))
+
+            def one(i):
+                status, _, data = runner.request(
+                    "POST", "/chat",
+                    {"prompt": f"flood {i}", "max_tokens": 24,
+                     "temperature": 0.0})
+                return status
+
+            with ThreadPoolExecutor(16) as pool:
+                statuses = list(pool.map(one, range(16)))
+        assert 503 in statuses          # backpressure is visible...
+        ok = [s for s in statuses if s == 201]
+        assert ok                       # ...while admitted work completes
+    finally:
+        engine.stop()
+
+
 def test_chat_missing_prompt(serving_app):
     status, _, data = serving_app.request("POST", "/chat", {"nope": 1})
     assert status == 400
