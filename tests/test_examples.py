@@ -302,6 +302,23 @@ def test_grpc_client_example():
             assert body["data"]["status"] == "SERVING"
 
 
+def test_multi_host_serving_example():
+    mod = load_example("multi-host-serving")
+    app = mod.build_app(cfg())
+    with AppRunner(app=app) as runner:
+        w1 = mod.run_worker(f"http://127.0.0.1:{runner.port}", "h1")
+        w2 = mod.run_worker(f"http://127.0.0.1:{runner.port}", "h2")
+        try:
+            status, body = runner.get_json("/control/topology")
+            assert status == 200
+            assert body["data"]["world_size"] == 2
+            assert w1.assignment.rank == 0
+            assert w2.assignment.rank == 1
+        finally:
+            w1.stop()
+            w2.stop()
+
+
 def test_model_serving():
     mod = load_example("model-serving")
     with AppRunner(app=mod.build_app(cfg())) as runner:
