@@ -23,7 +23,6 @@ def build_app(config=None, coordinator: str = "10.0.0.1:8476") -> App:
                                 heartbeat_interval_s=2.0,
                                 logger=app.logger)
     leader.install(app)
-    app._leader = leader  # reachable for tests
     return app
 
 

@@ -311,13 +311,41 @@ class App:
                 return False
         return True
 
+    async def _start_server(self, server, env_key: str,
+                            port: int) -> None:
+        """Start a listener with the reference's port-availability
+        guard (gofr.go:119-130): an occupied port fails boot with a
+        message naming the port AND the env key that moves it, not a
+        raw bind traceback."""
+        import errno
+        try:
+            await server.start()
+        except OSError as exc:
+            if exc.errno == errno.EADDRINUSE:
+                message = (f"port {port} is already in use; set "
+                           f"{env_key} to a free port")
+                self.logger.error(message)
+                raise RuntimeError(message) from exc
+            raise
+
     async def start(self) -> None:
-        """Boot all servers without blocking (for tests / embedding)."""
+        """Boot all servers without blocking (for tests / embedding).
+        A failed boot unwinds whatever already started — callers catch
+        one error against a clean slate, never a half-running app."""
         self._stop_event = asyncio.Event()
         await self.container.connect_async()
         if not await self._run_start_hooks():
             raise RuntimeError("on_start hook failed")
+        try:
+            await self._start_servers()
+        except BaseException:
+            try:
+                await self.stop()
+            except Exception as exc:
+                self.logger.warn(f"cleanup after failed boot: {exc!r}")
+            raise
 
+    async def _start_servers(self) -> None:
         handler = self._build_http_handler()
         # CERT_FILE + KEY_FILE switch the main listener to TLS
         # (reference pkg/gofr/http_server.go:74-86); the metrics port
@@ -338,13 +366,15 @@ class App:
         self.http_server = HTTPServer(
             handler, host="0.0.0.0", port=self.http_port,
             logger=self.logger, ssl_context=ssl_context)
-        await self.http_server.start()
+        await self._start_server(self.http_server, "HTTP_PORT",
+                                 self.http_port)
         self._servers.append(self.http_server)
 
         self.metrics_server = HTTPServer(
             self._build_metrics_handler(), host="0.0.0.0",
             port=self.metrics_port, logger=self.logger)
-        await self.metrics_server.start()
+        await self._start_server(self.metrics_server, "METRICS_PORT",
+                                 self.metrics_port)
         self._servers.append(self.metrics_server)
 
         if self.grpc_server is not None:

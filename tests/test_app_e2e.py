@@ -312,3 +312,28 @@ def test_static_mount_favicon_wins_over_builtin(tmp_path_factory):
     with AppRunner(build=build_routes) as app:
         status, _, data = app.request("GET", "/favicon.ico")
         assert status == 200 and data[:4] == b"\x89PNG"
+
+
+def test_occupied_port_fails_with_named_guidance():
+    """Port-occupancy guard (reference gofr.go:119-130): boot on a
+    taken port names the port and the env key, not a raw bind error."""
+    import asyncio
+    import socket
+
+    import pytest
+
+    from gofr_tpu.app import App
+    from gofr_tpu.config import DictConfig
+
+    blocker = socket.socket()
+    blocker.bind(("0.0.0.0", 0))  # wildcard: clashes on every platform
+    blocker.listen(1)
+    port = blocker.getsockname()[1]
+    try:
+        app = App(config=DictConfig({"HTTP_PORT": str(port),
+                                     "METRICS_PORT": "0",
+                                     "APP_NAME": "clash"}))
+        with pytest.raises(RuntimeError, match=f"{port}.*HTTP_PORT"):
+            asyncio.run(app.start())
+    finally:
+        blocker.close()
