@@ -249,6 +249,13 @@ class GRPCServer:
                 tuple(reflection_handler(lambda: sorted(names))))
         self.bound_port = self._server.add_insecure_port(
             f"0.0.0.0:{self.port}")
+        if self.bound_port == 0 and self.port != 0:
+            # grpc.aio reports bind failure as port 0, not an OSError —
+            # same friendly guard as the HTTP listeners
+            message = (f"port {self.port} is already in use (or cannot "
+                       f"bind); set GRPC_PORT to a free port")
+            self.logger.error(message)
+            raise RuntimeError(message)
         await self._server.start()
         self.logger.info(f"gRPC server listening on 0.0.0.0:{self.bound_port}")
 

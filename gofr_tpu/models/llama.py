@@ -257,6 +257,68 @@ def llama_decode_step(params: dict, tokens: jnp.ndarray,
     return logits, new_k, new_v
 
 
+def llama_prefill_chunk(params: dict, tokens: jnp.ndarray,
+                        k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+                        offsets: jnp.ndarray, chunk_lengths: jnp.ndarray,
+                        config: LlamaConfig, *,
+                        implementation: str = "auto"
+                        ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One chunk of a chunked prefill: process ``tokens`` [B, S] whose
+    row b starts at absolute position ``offsets[b]``, attending to the
+    cache rows written by earlier chunks plus intra-chunk causal, and
+    writing this chunk's K/V into the caches at
+    ``[offsets, offsets + chunk_lengths)``.
+
+    This is how prompts longer than the widest prefill bucket run
+    without truncation: the engine walks the prompt in bucket-width
+    chunks (long-context obligation, SURVEY §5). Returns
+    (last-position logits [B, V], new_k_cache, new_v_cache); caches
+    are [L, B, Smax, Hkv, hd] and meant to be donated.
+    """
+    from ..ops.attention import attention
+    c = config
+    b, s = tokens.shape
+    smax = k_cache.shape[2]
+    hd = c.head_dim
+    inv_freq = rope_frequencies(c.head_dim, c.rope_theta, c.rope_scaling)
+    positions = offsets[:, None] + jnp.arange(s)[None, :]      # [B, S]
+    valid = jnp.arange(s)[None, :] < chunk_lengths[:, None]    # [B, S]
+    # invalid rows scatter out of bounds and drop — padded tail rows
+    # must never overwrite live cache
+    write_pos = jnp.where(valid, positions, smax)
+    batch_idx = jnp.arange(b)
+    x = params["embed"][tokens]
+
+    def layer_fn(x, scanned):
+        lp, kc, vc = scanned
+        h = rms_norm(x, lp["attn_norm"], c.norm_eps)
+        q = (h @ lp["wq"]).reshape(b, s, c.n_heads, hd)
+        k = (h @ lp["wk"]).reshape(b, s, c.n_kv_heads, hd)
+        v = (h @ lp["wv"]).reshape(b, s, c.n_kv_heads, hd)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        kc = kc.at[batch_idx[:, None], write_pos].set(
+            k.astype(kc.dtype), mode="drop")
+        vc = vc.at[batch_idx[:, None], write_pos].set(
+            v.astype(vc.dtype), mode="drop")
+        # causal against the full history: query row s_i sees cache
+        # positions <= offsets + s_i (earlier chunks + intra-chunk).
+        # Dispatch follows the rest of the stack; q_offset != 0 routes
+        # to the XLA path today, and a future history-aware kernel
+        # picks it up here.
+        out = attention(q, kc, vc, causal=True, q_offset=offsets,
+                        implementation=implementation)
+        x = x + (out.reshape(b, s, c.n_heads * hd) @ lp["wo"])
+        x = x + _mlp_block(x, lp, c)
+        return x, (kc, vc)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_fn, x, (params["layers"], k_cache, v_cache))
+    last = jnp.take_along_axis(
+        x, jnp.maximum(chunk_lengths - 1, 0)[:, None, None], axis=1)[:, 0]
+    return _logits(params, c, last), new_k, new_v
+
+
 def make_empty_cache(config: LlamaConfig, batch: int,
                      max_seq: int | None = None) -> tuple[jnp.ndarray, jnp.ndarray]:
     c = config

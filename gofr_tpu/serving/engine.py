@@ -74,6 +74,9 @@ class GenRequest:
                                    # assigned once at first admission and
                                    # kept across preemption-requeues so a
                                    # re-admitted old request stays old
+    pending_prefill: bool = False  # mid chunked-prefill: holds a slot
+                                   # but must not decode yet
+    prefill_offset: int = 0        # next chunk's start position
 
     def _emit(self, token: int | None) -> None:
         if self.out_queue is not None and self.loop is not None:
@@ -122,6 +125,11 @@ class EngineConfig:
     #: together. 0 = unbounded. Already-admitted work that bounces
     #: back (preemption, slot races) bypasses the bound.
     max_waiting: int = 0
+    #: chunked-prefill pacing: how many bucket-width chunks of a long
+    #: prompt run per engine pass. Decode for every other slot
+    #: interleaves between passes, so one giant prompt cannot
+    #: head-of-line block the whole batch.
+    prefill_chunks_per_pass: int = 2
     #: "slot" = contiguous per-slot rows (max_batch x max_seq, simplest
     #: and fastest per step); "paged" = block-table indirection over a
     #: page pool (ops/paged_kv.py) — capacity decoupled from
@@ -149,7 +157,8 @@ class Engine:
 
     def __init__(self, params: Any, config: EngineConfig, *,
                  prefill_fn: Callable, decode_fn: Callable,
-                 make_cache: Callable, metrics: Any = None,
+                 make_cache: Callable, prefill_chunk_fn: Callable
+                 | None = None, metrics: Any = None,
                  logger: Any = None) -> None:
         self.params = params
         self.config = config
@@ -157,6 +166,11 @@ class Engine:
         self.logger = logger
         self._prefill_raw = prefill_fn
         self._make_cache = make_cache
+        # chunked prefill (long prompts in bucket-width chunks against
+        # the growing cache) rides the contiguous slot layout; the
+        # paged pool keeps the clamp
+        self._prefill_chunk_fn = (prefill_chunk_fn
+                                  if config.kv_layout == "slot" else None)
 
         cfg = config
         if cfg.kv_layout not in ("slot", "paged"):
@@ -348,11 +362,15 @@ class Engine:
     def close(self) -> None:
         self.stop()
 
-    def warmup(self, prompt_lens: tuple = (1,), decode: bool = True) -> None:
+    def warmup(self, prompt_lens: tuple = (1,), decode: bool = True,
+               chunked: bool = False) -> None:
         """Compile serving graphs ahead of traffic: every power-of-two
         prefill group size for each bucket covering ``prompt_lens``,
-        plus the decode pass. Dummy rows carry slot == max_batch so the
-        cache scatter drops them — real state is untouched. Call before
+        plus the decode pass. Pass ``chunked=True`` when prompts longer
+        than the widest bucket are expected, so the chunked-prefill
+        graph compiles here instead of inline on the first long
+        prompt. Dummy rows carry slot == max_batch so the cache
+        scatter drops them — real state is untouched. Call before
         ``start()`` (it exercises the donated caches)."""
         cfg = self.config
         paged = cfg.kv_layout == "paged"
@@ -383,16 +401,28 @@ class Engine:
                 jnp.zeros(b, jnp.float32), jnp.ones(b, jnp.float32),
                 jnp.zeros(b, jnp.int32))
             jax.block_until_ready(toks)
+        if chunked and self._prefill_chunk_fn is not None:
+            # compile the long-prompt chunk graph too (chunk_len 0:
+            # every cache write drops, the sample is discarded)
+            width = max(self._usable_buckets)
+            fn = self._get_chunk_prefill()
+            toks, self.k_cache, self.v_cache = fn(
+                self.params, jnp.zeros((1, width), jnp.int32),
+                self.k_cache, self.v_cache, np.int32(0), np.int32(0),
+                np.int32(0), np.int32(0), np.float32(0.0),
+                np.float32(1.0), np.int32(0))
+            jax.block_until_ready(toks)
 
     def _clamp_prompt(self, tokens: list[int], max_new: int) -> list[int]:
         """Keep the tail of an over-long prompt, reserving room to
-        generate; the largest usable prefill bucket is a hard cap — an
-        admitted prompt must fit the widest prefill graph AND the
-        cache. (Preemption-requeue clamps less aggressively: see
-        ``_preempt`` — its continuation already fit the cache.)"""
+        generate. With chunked prefill the cache is the only cap;
+        without it the widest prefill graph also bounds admission.
+        (Preemption-requeue clamps less aggressively: see ``_preempt``
+        — its continuation already fit the cache.)"""
         room = max(1, min(max_new, self.config.max_seq // 2))
-        limit = max(1, min(self.config.max_seq - room - 1,
-                           max(self._usable_buckets)))
+        limit = max(1, self.config.max_seq - room - 1)
+        if self._prefill_chunk_fn is None:
+            limit = min(limit, max(self._usable_buckets))
         return tokens[-limit:] if len(tokens) > limit else tokens
 
     # -------------------------------------------------------------- submit
@@ -513,6 +543,108 @@ class Engine:
             self._prefill_cache[(bucket, group)] = fn
         return fn
 
+    def _get_chunk_prefill(self) -> Callable:
+        """Fused single-slot chunk step: slice the slot's cache rows,
+        run one chunk forward against the history, splice the updated
+        rows back, and sample (only the final chunk's sample is used).
+        One graph serves every chunk of every long prompt — the width
+        is fixed at the widest prefill bucket."""
+        fn = self._prefill_cache.get("chunk")
+        if fn is None:
+            chunk_fn = self._prefill_chunk_fn
+            base_key = self._prefill_base_key
+
+            def fused(params, tokens, kc, vc, slot, offset, chunk_len,
+                      step, temp, top_p, top_k):
+                kcs = jax.lax.dynamic_slice_in_dim(kc, slot, 1, axis=1)
+                vcs = jax.lax.dynamic_slice_in_dim(vc, slot, 1, axis=1)
+                logits, kcs, vcs = chunk_fn(
+                    params, tokens, kcs, vcs, offset[None],
+                    chunk_len[None])
+                kc = jax.lax.dynamic_update_slice_in_dim(
+                    kc, kcs.astype(kc.dtype), slot, axis=1)
+                vc = jax.lax.dynamic_update_slice_in_dim(
+                    vc, vcs.astype(vc.dtype), slot, axis=1)
+                key = jax.random.fold_in(base_key, step)
+                tok = _sample_batch(logits, key, temp[None], top_p[None],
+                                    top_k[None])[0]
+                return tok, kc, vc
+            fn = jax.jit(fused, donate_argnums=(2, 3))
+            self._prefill_cache["chunk"] = fn
+        return fn
+
+    def _prefill_long(self, req: GenRequest, slot: int) -> None:
+        """Admit (or resume) a prompt longer than the widest bucket:
+        walk it in bucket-width chunks, each attending to the rows the
+        previous chunks wrote — no truncation (long-context
+        obligation). At most ``prefill_chunks_per_pass`` chunks run per
+        call; an unfinished walk requeues itself so decode for every
+        other slot interleaves instead of head-of-line blocking."""
+        cfg = self.config
+        width = max(self._usable_buckets)
+        prompt = req.prompt_tokens
+        self.active[slot] = req
+        req.slot = slot
+        req.pending_prefill = True
+        self._rng_step += 1
+        start = time.perf_counter()
+        try:
+            fn = self._get_chunk_prefill()
+            tok_dev = None
+            off = req.prefill_offset
+            for _ in range(max(1, int(cfg.prefill_chunks_per_pass))):
+                chunk = prompt[off:off + width]
+                tokens = np.zeros((1, width), np.int32)
+                tokens[0, :len(chunk)] = chunk
+                tok_dev, self.k_cache, self.v_cache = fn(
+                    self.params, jnp.asarray(tokens), self.k_cache,
+                    self.v_cache, np.int32(slot), np.int32(off),
+                    np.int32(len(chunk)), np.int32(self._rng_step),
+                    np.float32(req.params.temperature),
+                    np.float32(req.params.top_p),
+                    np.int32(req.params.top_k))
+                self.stats["prefill_calls"] += 1
+                off += len(chunk)
+                if off >= len(prompt):
+                    break
+            req.prefill_offset = off
+            self.stats["prefill_s"] += time.perf_counter() - start
+            if off < len(prompt):      # more chunks next pass
+                self._requeued.append(req)
+                return
+            first = int(np.asarray(tok_dev))
+        except Exception as exc:
+            self.active[slot] = None
+            req.pending_prefill = False
+            self._fail(req, str(exc))
+            if self.logger:
+                self.logger.error(f"chunked prefill failed: {exc!r}")
+            if self.k_cache.is_deleted() or self.v_cache.is_deleted():
+                for i, other in enumerate(self.active):
+                    if other is not None:
+                        self.active[i] = None
+                        self._fail(other,
+                                   f"kv cache lost to failed prefill: "
+                                   f"{exc}")
+                self.lengths[:] = 0
+                self.k_cache, self.v_cache = self._make_cache(
+                    cfg.max_batch, cfg.max_seq)
+            return
+
+        req.pending_prefill = False
+        now = time.time()
+        if req.first_token_at is None:  # not a preemption recompute
+            req.first_token_at = now
+            if self.metrics is not None:
+                self.metrics.record_histogram(
+                    "app_chat_ttft_seconds", now - req.submitted_at)
+        req.generated.append(first)
+        req._emit(first)
+        self.total_generated += 1
+        self.lengths[slot] = len(prompt)
+        if self._finished(req, first):
+            self._retire(slot)
+
     def _free_slot(self) -> int:
         for i, r in enumerate(self.active):
             if r is None:
@@ -591,9 +723,25 @@ class Engine:
 
     def _admit_batch(self, reqs: list[GenRequest]) -> None:
         """Admit a burst: group by prompt bucket, prefill each group in
-        chunks of ``prefill_batch`` with one device call per chunk."""
+        chunks of ``prefill_batch`` with one device call per chunk.
+        Prompts wider than every bucket take the chunked path."""
         by_bucket: dict[int, list[GenRequest]] = {}
+        widest = max(self._usable_buckets)
         for req in reqs:
+            if req.pending_prefill:  # resuming a chunk walk
+                if req.slot >= 0 and self.active[req.slot] is req:
+                    self._prefill_long(req, req.slot)
+                elif req.finished_at is None:  # slot lost (retired)
+                    self._fail(req, "chunked prefill lost its slot")
+                continue
+            if (self._prefill_chunk_fn is not None
+                    and len(req.prompt_tokens) > widest):
+                slot = self._free_slot()
+                if slot < 0:  # raced out of slots; try next pass
+                    self._requeued.append(req)
+                else:
+                    self._prefill_long(req, slot)
+                continue
             bucket = self._bucket_for(len(req.prompt_tokens))
             by_bucket.setdefault(bucket, []).append(req)
         P = max(1, self.config.prefill_batch)
@@ -758,8 +906,16 @@ class Engine:
         top_ps = np.ones(cfg.max_batch, np.float32)
         top_ks = np.zeros(cfg.max_batch, np.int32)
         active_mask = np.zeros(cfg.max_batch, bool)
+        device_lengths = self.lengths.copy()
         for i, req in enumerate(self.active):
             if req is None:
+                continue
+            if req.pending_prefill:
+                # mid chunked-prefill: the slot holds real KV rows the
+                # chunk walk wrote — the decode pass must neither write
+                # into them (length = max_seq makes the scatter drop)
+                # nor emit its garbage samples
+                device_lengths[i] = cfg.max_seq
                 continue
             active_mask[i] = True
             tokens[i] = req.generated[-1]
@@ -769,7 +925,7 @@ class Engine:
         if not active_mask.any():
             return
 
-        lengths = jnp.asarray(self.lengths)
+        lengths = jnp.asarray(device_lengths)
         self._rng_step += 1
         start = time.perf_counter()
         tables = (jnp.asarray(self._tables),) if paged else ()
@@ -786,7 +942,7 @@ class Engine:
 
         self._step_count += 1
         for i, req in enumerate(self.active):
-            if req is None:
+            if req is None or req.pending_prefill:
                 continue
             # steps whose cache write would land past max_seq-1 were
             # dropped by the device scatter and attended to stale rows;
@@ -831,8 +987,13 @@ class Engine:
                         live = []
                         for r in batch:
                             if r.cancelled:  # dropped before prefill
-                                r.finished_at = time.time()
-                                r._emit(None)
+                                if (r.pending_prefill and r.slot >= 0
+                                        and self.active[r.slot] is r):
+                                    # mid chunk-walk: free the slot too
+                                    self._retire(r.slot)
+                                elif r.finished_at is None:
+                                    r.finished_at = time.time()
+                                    r._emit(None)
                             else:
                                 live.append(r)
                         if live:
