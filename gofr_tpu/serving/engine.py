@@ -166,11 +166,10 @@ class Engine:
         self.logger = logger
         self._prefill_raw = prefill_fn
         self._make_cache = make_cache
-        # chunked prefill (long prompts in bucket-width chunks against
-        # the growing cache) rides the contiguous slot layout; the
-        # paged pool keeps the clamp
-        self._prefill_chunk_fn = (prefill_chunk_fn
-                                  if config.kv_layout == "slot" else None)
+        # chunked prefill: long prompts in bucket-width chunks against
+        # the growing cache (slot layout slices the cache; the paged
+        # layout gathers the slot's view and scatters the chunk back)
+        self._prefill_chunk_fn = prefill_chunk_fn
 
         cfg = config
         if cfg.kv_layout not in ("slot", "paged"):
@@ -271,10 +270,14 @@ class Engine:
         # exists (gofr_tpu/native), queue.Queue-semantics fallback
         from ..native.batch_queue import new_request_queue
         self.waiting = new_request_queue(config.max_waiting)
-        # already-admitted work bounced back (preemption, slot races):
-        # re-enters ahead of the public queue and NEVER counts against
-        # the admission bound — engine-thread only, no lock needed
+        # already-admitted work bounced back (preemption, slot races,
+        # chunk-walk pacing): re-enters ahead of the public queue and
+        # NEVER counts against the admission bound — engine-thread
+        # only, no lock needed
         self._requeued: list[GenRequest] = []
+        self._requeued_set: set[int] = set()  # id() dedup: a request
+        #                       preempted in the same pass it requeued
+        #                       itself must not enter twice
 
         self._rng_step = 0
         self._running = False
@@ -338,6 +341,7 @@ class Engine:
         for req in stranded or []:
             self._fail(req, reason)
         requeued, self._requeued = self._requeued, []
+        self._requeued_set.clear()
         for req in requeued:
             self._fail(req, reason)
         for i, req in enumerate(self.active):
@@ -406,9 +410,14 @@ class Engine:
             # every cache write drops, the sample is discarded)
             width = max(self._usable_buckets)
             fn = self._get_chunk_prefill()
+            if paged:  # an all-OOB table row: every gather clamps,
+                slot_arg = jnp.full((1, self._pages_per_slot),  # every
+                                    self._n_pages, jnp.int32)   # write
+            else:                                               # drops
+                slot_arg = np.int32(0)
             toks, self.k_cache, self.v_cache = fn(
                 self.params, jnp.zeros((1, width), jnp.int32),
-                self.k_cache, self.v_cache, np.int32(0), np.int32(0),
+                self.k_cache, self.v_cache, slot_arg, np.int32(0),
                 np.int32(0), np.int32(0), np.float32(0.0),
                 np.float32(1.0), np.int32(0))
             jax.block_until_ready(toks)
@@ -544,31 +553,59 @@ class Engine:
         return fn
 
     def _get_chunk_prefill(self) -> Callable:
-        """Fused single-slot chunk step: slice the slot's cache rows,
-        run one chunk forward against the history, splice the updated
-        rows back, and sample (only the final chunk's sample is used).
-        One graph serves every chunk of every long prompt — the width
-        is fixed at the widest prefill bucket."""
+        """Fused single-slot chunk step: bring the slot's cache rows
+        into a contiguous view (a slice for the slot layout, a page
+        gather for the paged pool), run one chunk forward against the
+        history, splice the written rows back, and sample (only the
+        final chunk's sample is used). One graph serves every chunk of
+        every long prompt — the width is fixed at the widest bucket."""
         fn = self._prefill_cache.get("chunk")
         if fn is None:
             chunk_fn = self._prefill_chunk_fn
             base_key = self._prefill_base_key
 
-            def fused(params, tokens, kc, vc, slot, offset, chunk_len,
-                      step, temp, top_p, top_k):
-                kcs = jax.lax.dynamic_slice_in_dim(kc, slot, 1, axis=1)
-                vcs = jax.lax.dynamic_slice_in_dim(vc, slot, 1, axis=1)
-                logits, kcs, vcs = chunk_fn(
-                    params, tokens, kcs, vcs, offset[None],
-                    chunk_len[None])
-                kc = jax.lax.dynamic_update_slice_in_dim(
-                    kc, kcs.astype(kc.dtype), slot, axis=1)
-                vc = jax.lax.dynamic_update_slice_in_dim(
-                    vc, vcs.astype(vc.dtype), slot, axis=1)
-                key = jax.random.fold_in(base_key, step)
-                tok = _sample_batch(logits, key, temp[None], top_p[None],
-                                    top_k[None])[0]
-                return tok, kc, vc
+            if self.config.kv_layout == "paged":
+                from ..ops.paged_kv import gather_view, scatter_decode
+
+                def fused(params, tokens, kp, vp, table_row, offset,
+                          chunk_len, step, temp, top_p, top_k):
+                    width = tokens.shape[1]
+                    k_view = gather_view(kp, table_row)
+                    v_view = gather_view(vp, table_row)
+                    logits, k_view, v_view = chunk_fn(
+                        params, tokens, k_view, v_view, offset[None],
+                        chunk_len[None])
+                    # write back exactly the chunk's row range; rows
+                    # beyond chunk_len round-trip their gathered values
+                    # and unallocated pages drop
+                    kp = scatter_decode(kp, table_row,
+                                        k_view.astype(kp.dtype),
+                                        offset[None], width)
+                    vp = scatter_decode(vp, table_row,
+                                        v_view.astype(vp.dtype),
+                                        offset[None], width)
+                    key = jax.random.fold_in(base_key, step)
+                    tok = _sample_batch(logits, key, temp[None],
+                                        top_p[None], top_k[None])[0]
+                    return tok, kp, vp
+            else:
+                def fused(params, tokens, kc, vc, slot, offset,
+                          chunk_len, step, temp, top_p, top_k):
+                    kcs = jax.lax.dynamic_slice_in_dim(kc, slot, 1,
+                                                       axis=1)
+                    vcs = jax.lax.dynamic_slice_in_dim(vc, slot, 1,
+                                                       axis=1)
+                    logits, kcs, vcs = chunk_fn(
+                        params, tokens, kcs, vcs, offset[None],
+                        chunk_len[None])
+                    kc = jax.lax.dynamic_update_slice_in_dim(
+                        kc, kcs.astype(kc.dtype), slot, axis=1)
+                    vc = jax.lax.dynamic_update_slice_in_dim(
+                        vc, vcs.astype(vc.dtype), slot, axis=1)
+                    key = jax.random.fold_in(base_key, step)
+                    tok = _sample_batch(logits, key, temp[None],
+                                        top_p[None], top_k[None])[0]
+                    return tok, kc, vc
             fn = jax.jit(fused, donate_argnums=(2, 3))
             self._prefill_cache["chunk"] = fn
         return fn
@@ -581,11 +618,18 @@ class Engine:
         call; an unfinished walk requeues itself so decode for every
         other slot interleaves instead of head-of-line blocking."""
         cfg = self.config
+        paged = cfg.kv_layout == "paged"
         width = max(self._usable_buckets)
         prompt = req.prompt_tokens
+        if paged and -(-(len(prompt) + 1) // cfg.page_size) > self._n_pages:
+            self._fail(req, "prompt exceeds kv pool")
+            return
         self.active[slot] = req
         req.slot = slot
         req.pending_prefill = True
+        if paged and req.admit_order < 0:
+            req.admit_order = self._admit_seq
+            self._admit_seq += 1
         self._rng_step += 1
         start = time.perf_counter()
         try:
@@ -594,11 +638,27 @@ class Engine:
             off = req.prefill_offset
             for _ in range(max(1, int(cfg.prefill_chunks_per_pass))):
                 chunk = prompt[off:off + width]
+                if paged:
+                    rows = min(off + len(chunk) + 1, cfg.max_seq)
+                    if not self._ensure_headroom(slot, rows):
+                        # the pool can't cover this walk even after
+                        # preempting younger requests: release and
+                        # restart from scratch once pages free up
+                        self._release_pages(slot)
+                        self.active[slot] = None
+                        req.prefill_offset = 0
+                        self._requeue(req)
+                        self.stats["prefill_s"] += \
+                            time.perf_counter() - start
+                        return
+                    slot_arg = jnp.asarray(self._tables[slot:slot + 1])
+                else:
+                    slot_arg = np.int32(slot)
                 tokens = np.zeros((1, width), np.int32)
                 tokens[0, :len(chunk)] = chunk
                 tok_dev, self.k_cache, self.v_cache = fn(
                     self.params, jnp.asarray(tokens), self.k_cache,
-                    self.v_cache, np.int32(slot), np.int32(off),
+                    self.v_cache, slot_arg, np.int32(off),
                     np.int32(len(chunk)), np.int32(self._rng_step),
                     np.float32(req.params.temperature),
                     np.float32(req.params.top_p),
@@ -610,25 +670,18 @@ class Engine:
             req.prefill_offset = off
             self.stats["prefill_s"] += time.perf_counter() - start
             if off < len(prompt):      # more chunks next pass
-                self._requeued.append(req)
+                self._requeue(req)
                 return
             first = int(np.asarray(tok_dev))
         except Exception as exc:
             self.active[slot] = None
+            if paged:
+                self._release_pages(slot)
             req.pending_prefill = False
             self._fail(req, str(exc))
             if self.logger:
                 self.logger.error(f"chunked prefill failed: {exc!r}")
-            if self.k_cache.is_deleted() or self.v_cache.is_deleted():
-                for i, other in enumerate(self.active):
-                    if other is not None:
-                        self.active[i] = None
-                        self._fail(other,
-                                   f"kv cache lost to failed prefill: "
-                                   f"{exc}")
-                self.lengths[:] = 0
-                self.k_cache, self.v_cache = self._make_cache(
-                    cfg.max_batch, cfg.max_seq)
+            self._recover_lost_cache(exc)
             return
 
         req.pending_prefill = False
@@ -695,9 +748,15 @@ class Engine:
         # max_seq, non-default).
         req.prompt_tokens = list(req.prompt_tokens) + list(req.generated)
         limit = min(max(self._usable_buckets), self.config.max_seq)
+        if self._prefill_chunk_fn is not None:
+            # chunked prefill re-admits any continuation the cache can
+            # hold — no bucket truncation
+            limit = self.config.max_seq
         if len(req.prompt_tokens) > limit:
             req.prompt_tokens = req.prompt_tokens[-limit:]
-        self._requeued.append(req)
+        if req.pending_prefill:  # evicted mid-walk: restart the walk
+            req.prefill_offset = 0
+        self._requeue(req)
 
     def _ensure_headroom(self, slot: int, rows: int) -> bool:
         """Allocate pages for ``rows`` logical rows, preempting the
@@ -716,6 +775,35 @@ class Engine:
                 victims, key=lambda i: self.active[i].admit_order))
         return True
 
+    def _requeue(self, req: GenRequest) -> None:
+        if id(req) not in self._requeued_set:
+            self._requeued_set.add(id(req))
+            self._requeued.append(req)
+
+    def _recover_lost_cache(self, exc: BaseException) -> None:
+        """A failed prefill may have consumed the donated caches; if
+        so every active slot's KV went with them — fail those streams
+        honestly and stand up fresh caches so the engine keeps serving
+        new requests."""
+        if not (self.k_cache.is_deleted() or self.v_cache.is_deleted()):
+            return
+        cfg = self.config
+        for i, other in enumerate(self.active):
+            if other is not None:
+                self.active[i] = None
+                self._fail(other, f"kv cache lost to failed prefill: "
+                                  f"{exc}")
+        self.lengths[:] = 0
+        if cfg.kv_layout == "paged":  # same geometry, pristine allocator
+            self.k_cache, self.v_cache = self._make_cache(
+                self._n_pages, cfg.page_size)
+            self._free_pages = list(range(self._n_pages))
+            self._tables[:] = self._n_pages
+            self._slot_pages[:] = 0
+        else:
+            self.k_cache, self.v_cache = self._make_cache(
+                cfg.max_batch, cfg.max_seq)
+
     def _fail(self, req: GenRequest, error: str) -> None:
         req.error = error
         req.finished_at = time.time()
@@ -728,17 +816,28 @@ class Engine:
         by_bucket: dict[int, list[GenRequest]] = {}
         widest = max(self._usable_buckets)
         for req in reqs:
+            if req.finished_at is not None:
+                continue  # failed/retired while queued
+            if (not req.pending_prefill and req.slot >= 0
+                    and self.active[req.slot] is req):
+                continue  # already serving (stale duplicate entry)
             if req.pending_prefill:  # resuming a chunk walk
                 if req.slot >= 0 and self.active[req.slot] is req:
                     self._prefill_long(req, req.slot)
-                elif req.finished_at is None:  # slot lost (retired)
-                    self._fail(req, "chunked prefill lost its slot")
+                elif req.finished_at is None:
+                    # slot lost (pool-exhaustion restart / preemption):
+                    # re-admit from scratch
+                    slot = self._free_slot()
+                    if slot < 0:
+                        self._requeue(req)
+                    else:
+                        self._prefill_long(req, slot)
                 continue
             if (self._prefill_chunk_fn is not None
                     and len(req.prompt_tokens) > widest):
                 slot = self._free_slot()
                 if slot < 0:  # raced out of slots; try next pass
-                    self._requeued.append(req)
+                    self._requeue(req)
                 else:
                     self._prefill_long(req, slot)
                 continue
@@ -756,7 +855,7 @@ class Engine:
         for req in chunk:
             slot = self._free_slot()
             if slot < 0:  # raced out of slots; back to the requeue list
-                self._requeued.append(req)
+                self._requeue(req)
                 continue
             if paged:
                 pg = cfg.page_size
@@ -767,7 +866,7 @@ class Engine:
                 if not self._alloc_pages(slot, len(req.prompt_tokens) + 1):
                     # pool busy: requeue and wait for retires to free
                     # pages
-                    self._requeued.append(req)
+                    self._requeue(req)
                     continue
                 if req.admit_order < 0:
                     req.admit_order = self._admit_seq
@@ -820,26 +919,7 @@ class Engine:
                 self._fail(req, str(exc))
             if self.logger:
                 self.logger.error(f"prefill failed: {exc!r}")
-            # the failed call may have consumed the donated caches; if
-            # so, every active slot's KV went with them — fail those
-            # streams honestly and stand up fresh caches so the engine
-            # keeps serving new requests
-            if self.k_cache.is_deleted() or self.v_cache.is_deleted():
-                for i, req in enumerate(self.active):
-                    if req is not None:
-                        self.active[i] = None
-                        self._fail(req, f"kv cache lost to failed prefill: "
-                                        f"{exc}")
-                self.lengths[:] = 0
-                if paged:  # same pool geometry + a pristine allocator
-                    self.k_cache, self.v_cache = self._make_cache(
-                        self._n_pages, cfg.page_size)
-                    self._free_pages = list(range(self._n_pages))
-                    self._tables[:] = self._n_pages
-                    self._slot_pages[:] = 0
-                else:
-                    self.k_cache, self.v_cache = self._make_cache(
-                        cfg.max_batch, cfg.max_seq)
+            self._recover_lost_cache(exc)
             return
 
         now = time.time()
@@ -968,15 +1048,25 @@ class Engine:
             while self._running:
                 free = sum(1 for r in self.active if r is None)
                 busy = free < self.config.max_batch
-                if free > 0:
-                    # requeued (already-admitted) work goes first and
-                    # bypasses the admission bound; then one batched
-                    # pop per pass (TTFT priority): blocks while fully
-                    # idle — in the native queue the engine thread
-                    # sleeps in C with the GIL released — and is a
-                    # zero-wait drain between decode steps while busy
+                if free > 0 or self._requeued:
+                    # requeued (already-admitted) work goes first,
+                    # bypasses the admission bound, and drains even
+                    # with zero free slots — mid-walk chunked prefills
+                    # HOLD their slot and must keep resuming; then one
+                    # batched pop per pass (TTFT priority): blocks
+                    # while fully idle — in the native queue the
+                    # engine thread sleeps in C with the GIL released
+                    # — and is a zero-wait drain between decode steps
+                    # while busy
                     batch, self._requeued = self._requeued, []
-                    take = free - len(batch)
+                    self._requeued_set.clear()
+                    # mid-walk resumes already hold their slot: they
+                    # must not eat capacity meant for waiting requests
+                    needing_slots = sum(
+                        1 for r in batch
+                        if not (r.pending_prefill and r.slot >= 0
+                                and self.active[r.slot] is r))
+                    take = free - needing_slots
                     if take > 0:
                         popped = self.waiting.pop_batch(
                             take,

@@ -76,8 +76,10 @@ class AppRunner:
 
     # -- tiny sync client
     def request(self, method: str, path: str, body: bytes | str | dict | None = None,
-                headers: dict | None = None, port: int | None = None):
-        conn = http.client.HTTPConnection("127.0.0.1", port or self.port, timeout=10)
+                headers: dict | None = None, port: int | None = None,
+                timeout: float = 10):
+        conn = http.client.HTTPConnection("127.0.0.1", port or self.port,
+                                          timeout=timeout)
         headers = dict(headers or {})
         if isinstance(body, dict):
             body = json.dumps(body)

@@ -84,16 +84,21 @@ def test_chunked_interleaves_with_bucketed_admission():
         engine.stop()
 
 
-def test_paged_layout_keeps_the_clamp():
-    """The paged pool has no chunked path (yet): long prompts clamp to
-    the widest bucket, exactly the pre-chunking behavior — no crash,
-    honest truncation."""
-    engine = demo_llama_engine(
+def test_paged_layout_chunks_and_matches_slot_layout():
+    """The paged pool walks long prompts too (gather view → chunk →
+    scatter back): unclamped, and greedy-identical to the slot
+    layout."""
+    paged = demo_llama_engine(
         EngineConfig(max_batch=2, max_seq=128, prefill_buckets=(8,),
                      kv_layout="paged", seed=7))
-    toks, kept = _generate(engine, PROMPT)
-    assert kept == 8  # clamped to the widest bucket
-    assert len(toks) == 6
+    toks_paged, kept = _generate(paged, PROMPT)
+    assert kept == len(PROMPT)  # nothing clamped
+
+    slot = demo_llama_engine(
+        EngineConfig(max_batch=2, max_seq=128, prefill_buckets=(8,),
+                     seed=7))
+    toks_slot, _ = _generate(slot, PROMPT)
+    assert toks_paged == toks_slot
 
 
 def test_cancel_mid_chunk_walk_frees_the_slot():
@@ -123,5 +128,90 @@ def test_cancel_mid_chunk_walk_frees_the_slot():
         follow = engine.submit_sync([1, 2, 3], SamplingParams(
             temperature=0.0, max_new_tokens=3))
         assert follow.error is None and len(follow.generated) == 3
+    finally:
+        engine.stop()
+
+
+def test_paged_prompt_exceeding_pool_fails_cleanly():
+    """A prompt that can never fit the page pool fails with a clear
+    error instead of walking forever or crashing the loop."""
+    engine = demo_llama_engine(
+        EngineConfig(max_batch=2, max_seq=128, prefill_buckets=(8,),
+                     kv_layout="paged", kv_pages=4, page_size=8,
+                     seed=1))
+    engine.start()
+    try:
+        req = engine.submit_sync(PROMPT, SamplingParams(
+            temperature=0.0, max_new_tokens=4))
+        assert req.error is not None and "kv pool" in req.error
+        # a fitting prompt still serves
+        ok = engine.submit_sync([1, 2, 3], SamplingParams(
+            temperature=0.0, max_new_tokens=3))
+        assert ok.error is None and len(ok.generated) == 3
+    finally:
+        engine.stop()
+
+
+def test_two_long_prompts_contend_for_the_pool():
+    """Pool smaller than both walks: preemption-by-recompute plus the
+    requeue machinery must land BOTH requests with exact token
+    budgets (regression: double-requeue once emitted a bogus extra
+    token; slot-holding walks once deadlocked the requeue drain)."""
+    import time
+
+    engine = demo_llama_engine(EngineConfig(
+        max_batch=2, max_seq=128, prefill_buckets=(8,),
+        kv_layout="paged", kv_pages=20, page_size=8,
+        prefill_chunks_per_pass=1, seed=4))
+    engine.start()
+    try:
+        a = engine.submit(list(range(3, 90)), SamplingParams(
+            temperature=0.0, max_new_tokens=4))
+        b = engine.submit(list(range(90, 175)), SamplingParams(
+            temperature=0.0, max_new_tokens=4))
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            if all(r.finished_at is not None or r.error for r in (a, b)):
+                break
+            time.sleep(0.02)
+        assert a.error is None and b.error is None, (a.error, b.error)
+        assert len(a.generated) == 4, len(a.generated)
+        assert len(b.generated) == 4, len(b.generated)
+    finally:
+        engine.stop()
+
+
+def test_warmup_chunked_compiles_both_layouts():
+    for layout in ("slot", "paged"):
+        engine = demo_llama_engine(
+            EngineConfig(max_batch=2, max_seq=64, prefill_buckets=(8,),
+                         kv_layout=layout, seed=1))
+        engine.warmup(prompt_lens=(8,), chunked=True)  # must not crash
+        toks, _ = _generate(engine, list(range(3, 30)), n=3)
+        assert len(toks) == 3
+
+
+def test_walker_does_not_starve_waiting_admission():
+    """A mid-walk long prompt holds one slot; a short prompt must be
+    admitted into the OTHER free slot while the walk is still going."""
+    import time
+
+    engine = demo_llama_engine(
+        EngineConfig(max_batch=2, max_seq=128, prefill_buckets=(8,),
+                     prefill_chunks_per_pass=1, seed=6))
+    engine.start()
+    try:
+        long_req = engine.submit(PROMPT, SamplingParams(
+            temperature=0.0, max_new_tokens=4))
+        short_req = engine.submit([9, 9, 9], SamplingParams(
+            temperature=0.0, max_new_tokens=2))
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if all(r.finished_at is not None or r.error
+                   for r in (long_req, short_req)):
+                break
+            time.sleep(0.01)
+        assert short_req.error is None and len(short_req.generated) == 2
+        assert long_req.error is None and len(long_req.generated) == 4
     finally:
         engine.stop()

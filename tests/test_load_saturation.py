@@ -46,7 +46,10 @@ def test_64_concurrent_chats_saturate_and_complete():
                 status, _, data = runner.request(
                     "POST", "/chat",
                     body={"prompt": f"load test request {i}",
-                          "max_tokens": GEN_TOKENS, "temperature": 0.0})
+                          "max_tokens": GEN_TOKENS, "temperature": 0.0},
+                    # saturation is the POINT: under a loaded suite the
+                    # tail request legitimately waits out the queue
+                    timeout=180)
                 payload = json.loads(data)
                 with lock:
                     if status != 201:
