@@ -1,0 +1,181 @@
+"""TCP fragmentation torture: a byte-dribbling proxy sits between each
+binary-protocol client and its mini server, forwarding one byte at a
+time in each direction. Framing code that assumes recv() returns whole
+packets breaks instantly under this; the exact-read loops must not.
+"""
+
+import socket
+import socketserver
+import threading
+
+import pytest
+
+
+class DribbleProxy:
+    """Forwards every byte individually, both directions."""
+
+    def __init__(self, upstream_host: str, upstream_port: int) -> None:
+        self.upstream = (upstream_host, upstream_port)
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                try:
+                    up = socket.create_connection(outer.upstream,
+                                                  timeout=30)
+                except OSError:
+                    return
+                stop = threading.Event()
+
+                def pump(src: socket.socket, dst: socket.socket) -> None:
+                    try:
+                        while not stop.is_set():
+                            data = src.recv(4096)
+                            if not data:
+                                break
+                            for i in range(len(data)):  # the torture
+                                dst.sendall(data[i:i + 1])
+                    except OSError:
+                        pass
+                    finally:
+                        stop.set()
+                        for s in (src, dst):
+                            try:
+                                s.shutdown(socket.SHUT_RDWR)
+                            except OSError:
+                                pass
+
+                t = threading.Thread(target=pump,
+                                     args=(up, self.request), daemon=True)
+                t.start()
+                pump(self.request, up)
+                t.join(5)
+
+        class TCP(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = TCP(("127.0.0.1", 0), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def test_postgres_survives_byte_dribble():
+    from gofr_tpu.datasource.postgres_wire import (MiniPostgresServer,
+                                                   PostgresWire)
+    srv = MiniPostgresServer(user="u", password="p", auth="scram-sha-256")
+    srv.start()
+    proxy = DribbleProxy("127.0.0.1", srv.port)
+    try:
+        db = PostgresWire(host="127.0.0.1", port=proxy.port,
+                          user="u", password="p")
+        db.connect()  # SCRAM handshake over 1-byte fragments
+        db.exec("CREATE TABLE t (a INTEGER, b TEXT)")
+        db.exec("INSERT INTO t VALUES ($1, $2)", 1, "x" * 500)
+        row = db.query_row("SELECT a, b FROM t")
+        assert row["a"] == 1 and len(row["b"]) == 500
+        db.close()
+    finally:
+        proxy.close()
+        srv.close()
+
+
+def test_mysql_survives_byte_dribble():
+    from gofr_tpu.datasource.mysql_wire import MiniMySQLServer, MySQLWire
+    srv = MiniMySQLServer(user="u", password="p")
+    srv.start()
+    proxy = DribbleProxy("127.0.0.1", srv.port)
+    try:
+        db = MySQLWire(host="127.0.0.1", port=proxy.port,
+                       user="u", password="p")
+        db.connect()  # challenge-response auth over fragments
+        db.exec("CREATE TABLE t (a INTEGER, b TEXT)")
+        db.exec("INSERT INTO t VALUES (?, ?)", 7, "y" * 300)
+        row = db.query_row("SELECT a, b FROM t")
+        assert row["a"] == 7 and len(row["b"]) == 300
+        db.close()
+    finally:
+        proxy.close()
+        srv.close()
+
+
+def test_cassandra_survives_byte_dribble():
+    from gofr_tpu.datasource.cassandra_wire import (CassandraWire,
+                                                    MiniCassandraServer)
+    srv = MiniCassandraServer(user="u", password="p")
+    srv.start()
+    proxy = DribbleProxy("127.0.0.1", srv.port)
+    try:
+        db = CassandraWire(host="127.0.0.1", port=proxy.port,
+                           username="u", password="p")
+        db.connect()  # SASL over 9-byte frames over fragments
+        db.exec("CREATE TABLE t (a INTEGER, b TEXT)")
+        db.exec("INSERT INTO t VALUES (?, ?)", 3, "z" * 200)
+        row = db.query("SELECT a, b FROM t")[0]
+        assert row["a"] == 3 and len(row["b"]) == 200
+        db.close()
+    finally:
+        proxy.close()
+        srv.close()
+
+
+def test_couchbase_kv_survives_byte_dribble():
+    from gofr_tpu.datasource.couchbase_wire import (CouchbaseWire,
+                                                    MiniCouchbaseServer)
+    srv = MiniCouchbaseServer(username="u", password="p")
+    srv.start()
+    proxy = DribbleProxy("127.0.0.1", srv.kv_port)
+    try:
+        cb = CouchbaseWire(host="127.0.0.1", kv_port=proxy.port,
+                           query_endpoint=f"127.0.0.1:{srv.query_port}",
+                           username="u", password="p")
+        cb.connect()  # SASL PLAIN over 24-byte headers over fragments
+        cb.upsert("b", "k", {"payload": "w" * 400})
+        assert len(cb.get("b", "k")["payload"]) == 400
+        cb.close()
+    finally:
+        proxy.close()
+        srv.close()
+
+
+def test_redis_survives_byte_dribble():
+    from gofr_tpu.datasource.redis_wire import MiniRedisServer, RedisWire
+    srv = MiniRedisServer()
+    srv.start()
+    proxy = DribbleProxy("127.0.0.1", srv.port)
+    try:
+        r = RedisWire(host="127.0.0.1", port=proxy.port)
+        r.connect()
+        r.set("k", "v" * 1000)
+        assert r.get("k") == "v" * 1000
+        r.close()
+    finally:
+        proxy.close()
+        srv.close()
+
+
+def test_sftp_over_ssh_survives_byte_dribble(tmp_path):
+    """The whole SSH2 stack — version exchange, curve25519 kex,
+    encrypted/MACed packets, auth, channel, SFTP — over 1-byte
+    fragments."""
+    from gofr_tpu.datasource.sftp_wire import MiniSFTPServer, SFTPWire
+    srv = MiniSFTPServer(tmp_path / "root", users={"u": "p"})
+    srv.start()
+    proxy = DribbleProxy("127.0.0.1", srv.port)
+    try:
+        fs = SFTPWire(host="127.0.0.1", port=proxy.port,
+                      username="u", password="p",
+                      expected_host_key=srv.host_public_key())
+        fs.connect()
+        fs.create("frag.bin", b"\x01\x02" * 256)
+        assert fs.read("frag.bin") == b"\x01\x02" * 256
+        fs.close()
+    finally:
+        proxy.close()
+        srv.close()
