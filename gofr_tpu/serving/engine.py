@@ -241,6 +241,13 @@ class Engine:
 
         self._failed: str | None = None
 
+        if self.metrics is not None and \
+                self.metrics.get("app_engine_active_slots") is None:
+            self.metrics.new_gauge("app_engine_active_slots",
+                                   "occupied decode slots")
+            self.metrics.new_gauge("app_engine_waiting",
+                                   "requests queued for admission")
+
         # prefill buckets wider than the cache would scatter K/V slabs
         # that cannot fit the [.., max_seq, ..] cache axis
         self._usable_buckets = tuple(
@@ -1042,6 +1049,15 @@ class Engine:
             if done or valid < K:
                 self._retire(i)
 
+    def _update_gauges(self) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.set_gauge(
+            "app_engine_active_slots",
+            float(sum(r is not None for r in self.active)))
+        self.metrics.set_gauge("app_engine_waiting",
+                               float(self.waiting.qsize()))
+
     # ---------------------------------------------------------------- loop
     def _loop(self) -> None:
         try:
@@ -1090,6 +1106,7 @@ class Engine:
                             self._admit_batch(live)
                 if any(r is not None for r in self.active):
                     self._decode_step()
+                self._update_gauges()
         except Exception as exc:  # containment: never die silently
             self._crash(exc)
         else:

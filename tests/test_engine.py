@@ -286,3 +286,23 @@ def test_engine_warmup_precompiles_and_serves():
                                                      max_new_tokens=6))
     ref.stop()
     assert warm.error is None and warm.generated == cold.generated
+
+
+def test_engine_exports_saturation_gauges():
+    from gofr_tpu.metrics.registry import Manager
+    from gofr_tpu.serving.glue import demo_llama_engine
+    from gofr_tpu.serving.engine import EngineConfig, SamplingParams
+
+    metrics = Manager()
+    engine = demo_llama_engine(EngineConfig(max_batch=2, max_seq=64,
+                                            seed=1), metrics=metrics)
+    engine.start()
+    try:
+        req = engine.submit_sync([1, 2, 3], SamplingParams(
+            temperature=0.0, max_new_tokens=4))
+        assert req.error is None
+    finally:
+        engine.stop()
+    scrape = metrics.render_prometheus()
+    assert "app_engine_active_slots" in scrape
+    assert "app_engine_waiting" in scrape

@@ -8,8 +8,6 @@ import socket
 import socketserver
 import threading
 
-import pytest
-
 
 class DribbleProxy:
     """Forwards every byte individually, both directions."""
