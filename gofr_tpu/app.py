@@ -256,7 +256,10 @@ class App:
             self.post(chat_path,
                       make_chat_handler(engine, tokenizer or ByteTokenizer()))
         self.on_start(lambda c: engine.start())
-        self.on_shutdown(engine.stop)
+        # close, not stop: the shutdown hook runs ON the event loop, so
+        # a wedged device call must only hold it for close()'s short
+        # join budget, not stop()'s full 30s
+        self.on_shutdown(engine.close)
 
     # ---------------------------------------------------------- lifecycle
     def _build_http_handler(self):

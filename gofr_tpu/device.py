@@ -124,10 +124,19 @@ class DeviceRegistry:
             if devices:
                 status = "DEGRADED"  # stale cache still served
         if self.engines:
-            details["engines"] = {
+            engine_health = {
                 name: (e.health_check() if hasattr(e, "health_check")
                        else {"status": "UP"})
                 for name, e in self.engines.items()}
+            details["engines"] = engine_health
+            # a stalled or crashed engine must surface at the slot
+            # level — the aggregate health endpoint only reads status
+            ranks = {"UP": 0, "DEGRADED": 1, "DOWN": 2}
+            worst = max((h.get("status", "UP") for h in
+                         engine_health.values()),
+                        key=lambda s: ranks.get(s, 1))
+            if ranks.get(worst, 0) > ranks.get(status, 0):
+                status = worst
         return {"status": status, "details": details}
 
     # ---------------------------------------------------------- metrics

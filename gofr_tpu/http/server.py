@@ -203,6 +203,9 @@ class HTTPServer:
         self.logger = logger
         self.ssl_context = ssl_context
         self._server: asyncio.AbstractServer | None = None
+        self._writers: set[asyncio.StreamWriter] = set()
+        self._conn_tasks: set[asyncio.Task] = set()
+        self._busy_tasks: set[asyncio.Task] = set()  # mid-request
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -226,10 +229,41 @@ class HTTPServer:
         async with self._server:
             await self._server.serve_forever()
 
-    async def shutdown(self) -> None:
+    async def shutdown(self, grace_s: float = 2.0) -> None:
+        """Stop accepting; idle keep-alive connections cancel
+        immediately, connections mid-request get ``grace_s`` to drain,
+        and stragglers are cancelled — ``wait_closed`` on 3.12+ waits
+        for EVERY connection handler, so a wedged stream would
+        otherwise hang shutdown indefinitely. Cancellation lands at
+        the handler's awaits, whose finally-blocks close stream
+        producers (the serving engine cancels abandoned requests)."""
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+            # idle connections are parked in read_request with no work
+            # in flight: nothing to drain, cancel now
+            for task in list(self._conn_tasks - self._busy_tasks):
+                task.cancel()
+            busy = set(self._busy_tasks)
+            if busy:
+                await asyncio.wait(busy, timeout=grace_s)
+            for task in list(self._conn_tasks):
+                task.cancel()
+            for writer in list(self._writers):
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+            try:
+                # hijacked websocket transports are closed by the WS
+                # manager, not tracked here — never let a straggler
+                # hold wait_closed forever
+                await asyncio.wait_for(self._server.wait_closed(),
+                                       timeout=max(grace_s, 2.0))
+            except asyncio.TimeoutError:
+                if self.logger:
+                    self.logger.warn(
+                        "listener closed with connections still "
+                        "terminating")
             self._server = None
 
     async def _serve_connection(self, reader: asyncio.StreamReader,
@@ -237,6 +271,10 @@ class HTTPServer:
         peer = writer.get_extra_info("peername")
         client_addr = f"{peer[0]}:{peer[1]}" if isinstance(peer, tuple) else ""
         took_over = False
+        self._writers.add(writer)
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
         try:
             while True:
                 try:
@@ -251,6 +289,8 @@ class HTTPServer:
                     break
                 if request is None:
                     break
+                if task is not None:  # a request is now in flight
+                    self._busy_tasks.add(task)
 
                 if "upgrade" in request.headers.get("connection", "").lower():
                     # hand the raw socket to the chain: the innermost
@@ -280,11 +320,20 @@ class HTTPServer:
                     if self.logger:
                         self.logger.error(f"stream aborted mid-response: {exc}")
                     break
+                finally:
+                    if task is not None:  # back to idle keep-alive
+                        self._busy_tasks.discard(task)
                 if not keep_alive:
                     break
         except (ConnectionResetError, BrokenPipeError, asyncio.IncompleteReadError):
             pass
         finally:
+            # hijacked (websocket) connections are owned by their
+            # message loop now — ws_manager closes them at shutdown
+            self._writers.discard(writer)
+            if task is not None:
+                self._conn_tasks.discard(task)
+                self._busy_tasks.discard(task)
             if not took_over:
                 try:
                     writer.close()

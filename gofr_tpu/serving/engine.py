@@ -130,6 +130,11 @@ class EngineConfig:
     #: interleaves between passes, so one giant prompt cannot
     #: head-of-line block the whole batch.
     prefill_chunks_per_pass: int = 2
+    #: stall detection: with work in flight, a loop that has not
+    #: completed a pass for this long (wedged device runtime, hung
+    #: tunnel) flips health to DEGRADED so orchestrators can act —
+    #: exceptions are contained separately (health DOWN). 0 disables.
+    stall_threshold_s: float = 120.0
     #: "slot" = contiguous per-slot rows (max_batch x max_seq, simplest
     #: and fastest per step); "paged" = block-table indirection over a
     #: page pool (ops/paged_kv.py) — capacity decoupled from
@@ -240,6 +245,7 @@ class Engine:
         self._prefill_fn = prefill_fn
 
         self._failed: str | None = None
+        self._last_beat = time.time()
 
         if self.metrics is not None and \
                 self.metrics.get("app_engine_active_slots") is None:
@@ -306,7 +312,7 @@ class Engine:
                                         name="gofr-engine")
         self._thread.start()
 
-    def stop(self) -> None:
+    def stop(self, join_timeout_s: float = 30.0) -> None:
         self._running = False
         if self._thread is not None:
             # the engine thread runs _shutdown_cleanup itself when the
@@ -314,7 +320,7 @@ class Engine:
             # compile outliving the join timeout) can never race
             # host-side cleanup: whoever finishes the loop retires the
             # streams, exactly once
-            self._thread.join(timeout=30)
+            self._thread.join(timeout=join_timeout_s)
             if self._thread.is_alive():
                 # still mid device call (slow compile or wedged
                 # runtime): fail the *queued* requests now — the live
@@ -359,19 +365,33 @@ class Engine:
 
     def health_check(self) -> dict:
         status = "DOWN" if (self._failed or not self._running) else "UP"
+        active = sum(r is not None for r in self.active)
+        waiting = self.waiting.qsize()
         out = {
             "status": status,
-            "active_slots": sum(r is not None for r in self.active),
-            "waiting": self.waiting.qsize(),
+            "active_slots": active,
+            "waiting": waiting,
             "steps": self._step_count,
             "total_generated": self.total_generated,
         }
+        threshold = self.config.stall_threshold_s
+        stalled_for = time.time() - self._last_beat
+        if (status == "UP" and threshold > 0 and (active or waiting)
+                and stalled_for > threshold):
+            # work in flight but no pass completing: a wedged device
+            # call (hung runtime/tunnel) — exceptions would have gone
+            # through _crash, so this is the only way to see a hang
+            out["status"] = "DEGRADED"
+            out["stalled_for_s"] = round(stalled_for, 1)
         if self._failed:
             out["error"] = self._failed
         return out
 
     def close(self) -> None:
-        self.stop()
+        # the app-shutdown path: a wedged device call must not hold
+        # graceful shutdown for the full join budget — the daemon
+        # thread dies with the process, queued requests fail now
+        self.stop(join_timeout_s=2.0)
 
     def warmup(self, prompt_lens: tuple = (1,), decode: bool = True,
                chunked: bool = False) -> None:
@@ -1062,6 +1082,7 @@ class Engine:
     def _loop(self) -> None:
         try:
             while self._running:
+                self._last_beat = time.time()
                 free = sum(1 for r in self.active if r is None)
                 busy = free < self.config.max_batch
                 if free > 0 or self._requeued:

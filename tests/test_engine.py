@@ -306,3 +306,48 @@ def test_engine_exports_saturation_gauges():
     scrape = metrics.render_prometheus()
     assert "app_engine_active_slots" in scrape
     assert "app_engine_waiting" in scrape
+
+
+def test_stalled_engine_reports_degraded():
+    """A wedged device call (the failure mode a hung TPU tunnel
+    produces) must flip health to DEGRADED while work is in flight —
+    exceptions go DOWN via _crash; a hang has no exception."""
+    import threading
+    import time as _time
+
+    from gofr_tpu.serving.glue import demo_llama_engine
+    from gofr_tpu.serving.engine import EngineConfig, SamplingParams
+
+    engine = demo_llama_engine(EngineConfig(max_batch=2, max_seq=64,
+                                            stall_threshold_s=0.2,
+                                            seed=1))
+    release = threading.Event()
+    original = engine._decode
+
+    def wedged(*args, **kw):
+        release.wait(30)  # simulate a hung device call
+        return original(*args, **kw)
+
+    engine._decode = wedged
+    engine.start()
+    try:
+        req = engine.submit(list(range(40)), SamplingParams(
+            temperature=0.0, max_new_tokens=8))
+        deadline = _time.time() + 10
+        while _time.time() < deadline:
+            if engine.health_check()["status"] == "DEGRADED":
+                break
+            _time.sleep(0.05)
+        health = engine.health_check()
+        assert health["status"] == "DEGRADED", health
+        assert health["stalled_for_s"] >= 0.2
+        release.set()  # device "recovers": request completes, health UP
+        deadline = _time.time() + 30
+        while _time.time() < deadline and req.finished_at is None \
+                and req.error is None:
+            _time.sleep(0.05)
+        assert req.error is None and len(req.generated) == 8
+        assert engine.health_check()["status"] == "UP"
+    finally:
+        release.set()
+        engine.stop()
