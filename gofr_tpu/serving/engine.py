@@ -147,6 +147,17 @@ class EngineConfig:
     #: capacity (max_batch x ceil(max_seq/page_size)). Smaller values
     #: overcommit: more concurrent short requests in the same HBM.
     kv_pages: int | None = None
+    #: paged layout only: retain retired requests' page-aligned prompt
+    #: prefixes and share them with later requests bearing the same
+    #: prefix (the common system prompt) — the suffix prefills through
+    #: the chunk-with-history path, skipping the shared compute
+    #: entirely. Shared pages are read-only by construction (decode
+    #: and suffix writes land past the aligned prefix) and refcounted;
+    #: cache entries evict LRU under pool pressure.
+    prefix_cache: bool = True
+    #: cap on pages pinned by the prefix cache; None = a quarter of
+    #: the pool.
+    prefix_cache_pages: int | None = None
 
 
 class Engine:
@@ -274,9 +285,26 @@ class Engine:
                                    self._n_pages, np.int32)
             self._slot_pages = np.zeros(cfg.max_batch, np.int32)
             self._admit_seq = 0
+            #: page refcounts: slots and the prefix cache each hold one
+            self._page_refs = np.zeros(self._n_pages, np.int32)
+            self._prefix_cache: dict[tuple, list[int]] = {}
+            #: pins held by the cache (entries may overlap on shared
+            #: pages, so this counts references, not distinct pages)
+            self._cached_pages = 0
+            #: cached key lengths -> entry count: probes test only
+            #: these lengths instead of every aligned prefix
+            self._prefix_lens: dict[int, int] = {}
+            # reattachment needs the chunk-with-history walk; without
+            # it a populated cache could never produce a hit
+            self._prefix_enabled = (cfg.prefix_cache
+                                    and prefill_chunk_fn is not None)
+            self._prefix_budget = (cfg.prefix_cache_pages
+                                   if cfg.prefix_cache_pages is not None
+                                   else max(1, self._n_pages // 4))
         else:
             self.k_cache, self.v_cache = make_cache(cfg.max_batch,
                                                     cfg.max_seq)
+            self._prefix_enabled = False  # sharing needs page tables
         self.lengths = np.zeros(cfg.max_batch, np.int32)       # kv length per slot
         self.active: list[GenRequest | None] = [None] * cfg.max_batch
         # admission queue: C++ waitable batch queue when a toolchain
@@ -301,7 +329,8 @@ class Engine:
         #: per-phase wall time (device call + sync) for perf accounting;
         #: the bench surfaces these as the per-phase breakdown
         self.stats = {"prefill_calls": 0, "prefill_s": 0.0,
-                      "decode_passes": 0, "decode_s": 0.0}
+                      "decode_passes": 0, "decode_s": 0.0,
+                      "prefix_hits": 0}
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> None:
@@ -649,6 +678,10 @@ class Engine:
         width = max(self._usable_buckets)
         prompt = req.prompt_tokens
         if paged and -(-(len(prompt) + 1) // cfg.page_size) > self._n_pages:
+            # an attached prefix (incref'd before this call) must not
+            # leak into the slot's table for the next occupant
+            self._release_pages(slot)
+            req.prefill_offset = 0
             self._fail(req, "prompt exceeds kv pool")
             return
         self.active[slot] = req
@@ -732,27 +765,112 @@ class Engine:
         return -1
 
     # ------------------------------------------------------ paged alloc
+    def _decref_page(self, page: int) -> None:
+        self._page_refs[page] -= 1
+        if self._page_refs[page] <= 0:
+            self._page_refs[page] = 0
+            self._free_pages.append(page)
+
+    def _evict_prefix_entries(self, pages_needed: int) -> None:
+        """Drop LRU prefix-cache entries (insertion order IS the LRU
+        order — touches reinsert) until the free list can cover
+        ``pages_needed`` or the cache is empty."""
+        while len(self._free_pages) < pages_needed and self._prefix_cache:
+            key = next(iter(self._prefix_cache))
+            pages = self._prefix_cache.pop(key)
+            count = self._prefix_lens.get(len(key), 0) - 1
+            if count > 0:
+                self._prefix_lens[len(key)] = count
+            else:
+                self._prefix_lens.pop(len(key), None)
+            self._cached_pages -= len(pages)
+            for page in pages:
+                self._decref_page(page)
+
     def _alloc_pages(self, slot: int, rows: int) -> bool:
         """Grow ``slot``'s block table to cover ``rows`` logical rows;
-        False when the free list cannot (caller preempts or defers)."""
+        False when the free list cannot even after evicting cached
+        prefixes (caller preempts or defers)."""
         pg = self.config.page_size
         need = min(-(-rows // pg), self._pages_per_slot)
         have = int(self._slot_pages[slot])
         if need <= have:
             return True
         if need - have > len(self._free_pages):
+            self._evict_prefix_entries(need - have)
+        if need - have > len(self._free_pages):
             return False
         for i in range(have, need):
-            self._tables[slot, i] = self._free_pages.pop()
+            page = self._free_pages.pop()
+            self._tables[slot, i] = page
+            self._page_refs[page] = 1
         self._slot_pages[slot] = need
         return True
 
     def _release_pages(self, slot: int) -> None:
         n = int(self._slot_pages[slot])
         for i in range(n):
-            self._free_pages.append(int(self._tables[slot, i]))
+            self._decref_page(int(self._tables[slot, i]))
         self._tables[slot, :] = self._n_pages
         self._slot_pages[slot] = 0
+
+    # ------------------------------------------------------ prefix cache
+    def _probe_prefix(self, prompt: list[int]) -> int:
+        """-> covered rows of the longest cached page-aligned prefix
+        of ``prompt`` (0 = miss). Always leaves >= 1 suffix token so
+        the first sample has a position to come from. Only lengths
+        that actually exist in the cache are tested."""
+        if not self._prefix_enabled or not self._prefix_cache:
+            return 0
+        limit = len(prompt) - 1
+        for length in sorted(self._prefix_lens, reverse=True):
+            if length <= limit \
+                    and tuple(prompt[:length]) in self._prefix_cache:
+                return length
+        return 0
+
+    def _attach_prefix(self, slot: int, prompt: list[int],
+                       covered: int) -> None:
+        """Point ``slot``'s table at the cached pages for
+        ``prompt[:covered]`` (increfs them) — the slot starts with the
+        shared prefix KV already in place."""
+        key = tuple(prompt[:covered])
+        pages = self._prefix_cache.pop(key)   # LRU touch: reinsert at
+        self._prefix_cache[key] = pages       # the fresh end
+        for i, page in enumerate(pages):
+            self._tables[slot, i] = page
+            self._page_refs[page] += 1
+        self._slot_pages[slot] = len(pages)
+        self.stats["prefix_hits"] += 1
+
+    def _register_prefix(self, slot: int, req: GenRequest) -> None:
+        """At retire: pin the page-aligned prompt prefix for reuse.
+        Decode wrote only past the prompt, so these pages hold exactly
+        the prefix KV."""
+        cfg = self.config
+        if not self._prefix_enabled:
+            return
+        pg = cfg.page_size
+        prompt = req.prompt_tokens
+        aligned = ((len(prompt) - 1) // pg) * pg
+        n = aligned // pg
+        if n < 1 or int(self._slot_pages[slot]) < n:
+            return
+        # when the full prefix exceeds the budget, pin the longest
+        # aligned prefix that fits — partial reuse beats none
+        n = min(n, self._prefix_budget - self._cached_pages)
+        if n < 1:
+            return
+        aligned = n * pg
+        key = tuple(prompt[:aligned])
+        if key in self._prefix_cache:
+            return
+        pages = [int(self._tables[slot, i]) for i in range(n)]
+        for page in pages:
+            self._page_refs[page] += 1
+        self._prefix_cache[key] = pages
+        self._prefix_lens[aligned] = self._prefix_lens.get(aligned, 0) + 1
+        self._cached_pages += n
 
     def _preempt(self, slot: int) -> None:
         """Evict a request, keeping its stream open: pages return to
@@ -781,8 +899,9 @@ class Engine:
             limit = self.config.max_seq
         if len(req.prompt_tokens) > limit:
             req.prompt_tokens = req.prompt_tokens[-limit:]
-        if req.pending_prefill:  # evicted mid-walk: restart the walk
-            req.prefill_offset = 0
+        # any chunk/suffix progress is gone with the pages: restart
+        # from zero (a cached prefix can re-attach at re-admission)
+        req.prefill_offset = 0
         self._requeue(req)
 
     def _ensure_headroom(self, slot: int, rows: int) -> bool:
@@ -827,6 +946,10 @@ class Engine:
             self._free_pages = list(range(self._n_pages))
             self._tables[:] = self._n_pages
             self._slot_pages[:] = 0
+            self._page_refs[:] = 0
+            self._prefix_cache.clear()
+            self._prefix_lens.clear()
+            self._cached_pages = 0
         else:
             self.k_cache, self.v_cache = self._make_cache(
                 cfg.max_batch, cfg.max_seq)
@@ -860,6 +983,20 @@ class Engine:
                     else:
                         self._prefill_long(req, slot)
                 continue
+            if self._prefix_enabled and req.prefill_offset == 0:
+                covered = self._probe_prefix(req.prompt_tokens)
+                if covered:
+                    slot = self._free_slot()
+                    if slot < 0:
+                        self._requeue(req)
+                    else:
+                        # shared prefix KV attaches; only the suffix
+                        # computes, through the chunk-with-history walk
+                        self._attach_prefix(slot, req.prompt_tokens,
+                                            covered)
+                        req.prefill_offset = covered
+                        self._prefill_long(req, slot)
+                    continue
             if (self._prefill_chunk_fn is not None
                     and len(req.prompt_tokens) > widest):
                 slot = self._free_slot()
@@ -978,6 +1115,8 @@ class Engine:
         self.active[slot] = None
         self.lengths[slot] = 0
         if self.config.kv_layout == "paged":
+            if req.error is None and not req.cancelled:
+                self._register_prefix(slot, req)
             self._release_pages(slot)
 
     # -------------------------------------------------------------- decode
