@@ -147,3 +147,58 @@ def test_attach_then_pool_exceed_does_not_corrupt_cache():
             == engine._n_pages - int((refs > 0).sum())
     finally:
         engine.stop()
+
+
+def test_allocator_invariants_under_random_churn():
+    """Hundreds of randomized submits — shared prefixes, long prompts,
+    cancellations, pool pressure with preemption and cache eviction —
+    then drain: every request resolves, and the refcount ledger
+    balances exactly (free + referenced == pool; references == cache
+    pins when idle)."""
+    rng = np.random.RandomState(42)
+    engine = demo_llama_engine(_cfg(max_batch=3, kv_pages=24,
+                                    prefill_chunks_per_pass=1))
+    engine.start()
+    reqs = []
+    try:
+        prefixes = [list(rng.randint(3, 200, size=n)) for n in (17, 33)]
+        for i in range(60):
+            kind = rng.randint(4)
+            if kind == 0:      # shared-prefix request
+                prompt = prefixes[rng.randint(2)] \
+                    + list(rng.randint(3, 200, size=rng.randint(1, 6)))
+            elif kind == 1:    # long prompt (chunk walk)
+                prompt = list(rng.randint(3, 200,
+                                          size=rng.randint(40, 90)))
+            else:              # short unique prompt
+                prompt = list(rng.randint(3, 200,
+                                          size=rng.randint(2, 12)))
+            req = engine.submit(prompt, SamplingParams(
+                temperature=0.0,
+                max_new_tokens=int(rng.randint(1, 6))))
+            reqs.append(req)
+            if rng.rand() < 0.2:
+                engine.cancel(req)
+            if rng.rand() < 0.3:
+                time.sleep(0.01)
+
+        deadline = time.time() + 240
+        while time.time() < deadline and any(
+                r.finished_at is None and r.error is None for r in reqs):
+            time.sleep(0.02)
+        unresolved = [r for r in reqs
+                      if r.finished_at is None and r.error is None]
+        assert not unresolved, f"{len(unresolved)} requests never resolved"
+
+        refs = engine._page_refs
+        assert all(r is None for r in engine.active)
+        assert int(engine._slot_pages.sum()) == 0
+        assert len(engine._free_pages) \
+            == engine._n_pages - int((refs > 0).sum())
+        # at quiescence, the only references are the cache's pins
+        cache_refs = sum(len(p) for p in engine._prefix_cache.values())
+        assert int(refs.sum()) == cache_refs
+        # no page is both free and referenced
+        assert all(refs[p] == 0 for p in engine._free_pages)
+    finally:
+        engine.stop()
