@@ -261,7 +261,8 @@ def llama_prefill_chunk(params: dict, tokens: jnp.ndarray,
                         k_cache: jnp.ndarray, v_cache: jnp.ndarray,
                         offsets: jnp.ndarray, chunk_lengths: jnp.ndarray,
                         config: LlamaConfig, *,
-                        implementation: str = "auto"
+                        implementation: str = "auto",
+                        return_all_logits: bool = False
                         ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One chunk of a chunked prefill: process ``tokens`` [B, S] whose
     row b starts at absolute position ``offsets[b]``, attending to the
@@ -314,6 +315,11 @@ def llama_prefill_chunk(params: dict, tokens: jnp.ndarray,
 
     x, (new_k, new_v) = jax.lax.scan(
         layer_fn, x, (params["layers"], k_cache, v_cache))
+    if return_all_logits:
+        # speculative verification wants every fed position's logits
+        # (S is the small draft window there, so the [S, V] head is
+        # cheap — unlike prompt prefill, where last-only matters)
+        return _logits(params, c, x), new_k, new_v
     last = jnp.take_along_axis(
         x, jnp.maximum(chunk_lengths - 1, 0)[:, None, None], axis=1)[:, 0]
     return _logits(params, c, last), new_k, new_v

@@ -158,6 +158,18 @@ class EngineConfig:
     #: cap on pages pinned by the prefix cache; None = a quarter of
     #: the pool.
     prefix_cache_pages: int | None = None
+    #: speculative decoding (opt-in): draft tokens by prompt-lookup
+    #: (an n-gram of the recent context matched earlier in
+    #: prompt+generated proposes its continuation) and verify them in
+    #: ONE parallel pass — accepted drafts + one bonus token land per
+    #: pass instead of one token. Greedy outputs are identical to
+    #: vanilla decode; non-greedy slots never accept drafts (their
+    #: bonus token still samples with their own params).
+    speculative: bool = False
+    #: max draft tokens verified per pass
+    spec_draft: int = 4
+    #: n-gram width the prompt-lookup draft matches on
+    spec_ngram: int = 3
 
 
 class Engine:
@@ -174,7 +186,8 @@ class Engine:
     def __init__(self, params: Any, config: EngineConfig, *,
                  prefill_fn: Callable, decode_fn: Callable,
                  make_cache: Callable, prefill_chunk_fn: Callable
-                 | None = None, metrics: Any = None,
+                 | None = None, spec_verify_fn: Callable | None = None,
+                 metrics: Any = None,
                  logger: Any = None) -> None:
         self.params = params
         self.config = config
@@ -186,6 +199,10 @@ class Engine:
         # the growing cache (slot layout slices the cache; the paged
         # layout gathers the slot's view and scatters the chunk back)
         self._prefill_chunk_fn = prefill_chunk_fn
+        self._spec_verify_fn = spec_verify_fn
+        self._spec_enabled = (config.speculative
+                              and spec_verify_fn is not None)
+        self._spec_toggle = True  # mixed-batch alternation state
 
         cfg = config
         if cfg.kv_layout not in ("slot", "paged"):
@@ -330,7 +347,8 @@ class Engine:
         #: the bench surfaces these as the per-phase breakdown
         self.stats = {"prefill_calls": 0, "prefill_s": 0.0,
                       "decode_passes": 0, "decode_s": 0.0,
-                      "prefix_hits": 0}
+                      "prefix_hits": 0, "spec_passes": 0,
+                      "spec_accepted": 0}
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> None:
@@ -1208,6 +1226,185 @@ class Engine:
             if done or valid < K:
                 self._retire(i)
 
+    # ------------------------------------------------- speculative decode
+    def _get_spec_verify(self) -> Callable:
+        """Fused verify pass over all slots: feed [last_token,
+        d_1..d_D] per row at its cache offset, greedy-predict every
+        position, count the accepted draft prefix in-graph, and emit
+        one bonus token sampled at the first divergence — per-row
+        sampling params decide the bonus (greedy rows take the argmax
+        path inside _sample_batch). Returns (accepted[B], bonus[B])."""
+        fn = self._prefill_cache.get("spec")
+        if fn is None:
+            verify_fn = self._spec_verify_fn
+            base_key = self._prefill_base_key
+            paged = self.config.kv_layout == "paged"
+            if paged:
+                from ..ops.paged_kv import gather_view, scatter_decode
+
+            def _accept_and_bonus(logits, tokens, chunk_lens, step,
+                                  temps, top_ps, top_ks):
+                s_width = tokens.shape[1]
+                pred = jnp.argmax(logits, axis=-1)        # [B, S]
+                # draft i (tokens[:, i+1]) is accepted iff it equals
+                # the greedy prediction at position i, and every
+                # earlier draft was accepted
+                drafts = chunk_lens - 1                    # [B]
+                matches = (pred[:, :-1] == tokens[:, 1:]) & \
+                    (jnp.arange(s_width - 1)[None, :] < drafts[:, None])
+                accepted = jnp.cumprod(
+                    matches.astype(jnp.int32), axis=1).sum(axis=1)
+                bonus_logits = jnp.take_along_axis(
+                    logits, accepted[:, None, None], axis=1)[:, 0]
+                key = jax.random.fold_in(base_key, step)
+                bonus = _sample_batch(bonus_logits, key, temps,
+                                      top_ps, top_ks)
+                return accepted, bonus
+
+            if paged:
+                def fused(params, tokens, kc, vc, tables, offsets,
+                          chunk_lens, step, temps, top_ps, top_ks):
+                    s_width = tokens.shape[1]
+                    k_view = gather_view(kc, tables)
+                    v_view = gather_view(vc, tables)
+                    logits, k_view, v_view = verify_fn(
+                        params, tokens, k_view, v_view, offsets,
+                        chunk_lens)
+                    kc = scatter_decode(kc, tables,
+                                        k_view.astype(kc.dtype),
+                                        offsets, s_width)
+                    vc = scatter_decode(vc, tables,
+                                        v_view.astype(vc.dtype),
+                                        offsets, s_width)
+                    accepted, bonus = _accept_and_bonus(
+                        logits, tokens, chunk_lens, step, temps,
+                        top_ps, top_ks)
+                    return accepted, bonus, kc, vc
+            else:
+                def fused(params, tokens, kc, vc, offsets, chunk_lens,
+                          step, temps, top_ps, top_ks):
+                    logits, kc, vc = verify_fn(params, tokens, kc, vc,
+                                               offsets, chunk_lens)
+                    accepted, bonus = _accept_and_bonus(
+                        logits, tokens, chunk_lens, step, temps,
+                        top_ps, top_ks)
+                    return accepted, bonus, kc, vc
+            fn = jax.jit(fused, donate_argnums=(2, 3))
+            self._prefill_cache["spec"] = fn
+        return fn
+
+    def _draft_proposals(self, req: GenRequest) -> list[int]:
+        """Prompt-lookup drafting: match the last n-gram of the
+        context against its own history; propose the continuation of
+        the most recent earlier occurrence."""
+        cfg = self.config
+        n = max(1, cfg.spec_ngram)
+        context = req.prompt_tokens + req.generated
+        if len(context) <= n:
+            return []
+        tail = context[-n:]
+        # scan recent history (bounded), newest match first
+        start = max(0, len(context) - n - 512)
+        for pos in range(len(context) - n - 1, start - 1, -1):
+            if context[pos:pos + n] == tail:
+                continuation = context[pos + n:pos + n + cfg.spec_draft]
+                remaining = req.params.max_new_tokens - len(req.generated)
+                return continuation[:max(0, remaining - 1)]
+        return []
+
+    def _spec_pass(self, proposals: dict[int, list[int]]) -> None:
+        """One speculative verify pass over every active slot. Slots
+        without drafts ride along with D=0 — for them this is exactly
+        a single decode step."""
+        cfg = self.config
+        paged = cfg.kv_layout == "paged"
+        # same pre-pass retirement contract as _decode_step: cancelled
+        # or at-ceiling slots leave before any compute
+        for i, req in enumerate(self.active):
+            if req is not None and (req.cancelled
+                                    or self.lengths[i] >= cfg.max_seq):
+                self._retire(i)
+        width = cfg.spec_draft + 1
+        b = cfg.max_batch
+        tokens = np.zeros((b, width), np.int32)
+        chunk_lens = np.ones(b, np.int32)
+        offsets = np.full(b, cfg.max_seq, np.int32)  # inactive: drop
+        temps = np.zeros(b, np.float32)
+        top_ps = np.ones(b, np.float32)
+        top_ks = np.zeros(b, np.int32)
+        rows = []
+        for i, req in enumerate(self.active):
+            if req is None or req.pending_prefill:
+                continue
+            drafts = proposals.get(i, [])
+            tokens[i, 0] = req.generated[-1]
+            for j, tok in enumerate(drafts):
+                tokens[i, 1 + j] = tok
+            chunk_lens[i] = 1 + len(drafts)
+            offsets[i] = int(self.lengths[i])
+            temps[i] = req.params.temperature
+            top_ps[i] = req.params.top_p
+            top_ks[i] = req.params.top_k
+            rows.append(i)
+        if not rows:
+            return
+        if paged:
+            # headroom for every fed row (drafts write cache rows too);
+            # an earlier row's headroom may preempt a later one
+            for i in list(rows):
+                if self.active[i] is None:  # preempted as a victim
+                    continue
+                rows_needed = min(int(self.lengths[i]) + width,
+                                  cfg.max_seq)
+                if not self._ensure_headroom(i, rows_needed):
+                    self._preempt(i)
+        tables = (jnp.asarray(self._tables),) if paged else ()
+        self._rng_step += 1
+        start = time.perf_counter()
+        fn = self._get_spec_verify()
+        accepted_dev, bonus_dev, self.k_cache, self.v_cache = fn(
+            self.params, jnp.asarray(tokens), self.k_cache,
+            self.v_cache, *tables, jnp.asarray(offsets),
+            jnp.asarray(chunk_lens), np.int32(self._rng_step),
+            jnp.asarray(temps), jnp.asarray(top_ps),
+            jnp.asarray(top_ks))
+        accepted = np.asarray(accepted_dev)
+        bonus = np.asarray(bonus_dev)
+        self.stats["spec_passes"] += 1
+        self.stats["decode_s"] += time.perf_counter() - start
+        if self.metrics is not None:
+            self.metrics.record_histogram(
+                "app_tpu_execute_seconds", time.perf_counter() - start)
+
+        self._step_count += 1
+        for i, req in enumerate(self.active):
+            if req is None or req.pending_prefill:
+                continue
+            n_acc = int(accepted[i])
+            emitted = proposals.get(i, [])[:n_acc] + [int(bonus[i])]
+            self.stats["spec_accepted"] += n_acc
+            # rows for the fed tokens were written at offsets..; only
+            # the accepted prefix (plus the already-cached last token)
+            # counts — rejected rows are overwritten by later passes
+            # and never attended (length-masked)
+            ceiling = cfg.max_seq - int(self.lengths[i])
+            done = False
+            kept = 0
+            for token in emitted:
+                if kept >= ceiling:
+                    done = True
+                    break
+                req.generated.append(token)
+                req._emit(token)
+                self.total_generated += 1
+                kept += 1
+                if self._finished(req, token):
+                    done = True
+                    break
+            self.lengths[i] += kept
+            if done or kept >= ceiling:
+                self._retire(i)
+
     def _update_gauges(self) -> None:
         if self.metrics is None:
             return
@@ -1265,7 +1462,31 @@ class Engine:
                         if live:
                             self._admit_batch(live)
                 if any(r is not None for r in self.active):
-                    self._decode_step()
+                    proposals: dict[int, list[int]] = {}
+                    decoding = 0
+                    if self._spec_enabled:
+                        for i, r in enumerate(self.active):
+                            if (r is None or r.pending_prefill
+                                    or r.cancelled):
+                                continue
+                            decoding += 1
+                            if r.params.temperature == 0.0:
+                                drafted = self._draft_proposals(r)
+                                if drafted:
+                                    proposals[i] = drafted
+                    # mixed batches alternate: a verify pass advances
+                    # non-drafting slots by ONE token, so they get a
+                    # full K-step decode pass every other iteration —
+                    # bounding their slowdown instead of starving them
+                    # while a peer keeps drafting
+                    run_spec = bool(proposals) and (
+                        len(proposals) == decoding or self._spec_toggle)
+                    if run_spec:
+                        self._spec_toggle = False
+                        self._spec_pass(proposals)
+                    else:
+                        self._spec_toggle = True
+                        self._decode_step()
                 self._update_gauges()
         except Exception as exc:  # containment: never die silently
             self._crash(exc)

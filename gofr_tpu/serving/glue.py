@@ -82,6 +82,15 @@ def llama_engine(params: Any, model_config: LlamaConfig,
             kc, vc = constrain_kv(kc), constrain_kv(vc)
         return logits, kc, vc
 
+    def spec_verify_fn(params, tokens, k_cache, v_cache, offsets,
+                       chunk_lengths):
+        logits, kc, vc = llama_prefill_chunk(
+            params, tokens, k_cache, v_cache, offsets, chunk_lengths, c,
+            implementation=implementation, return_all_logits=True)
+        if constrain_kv is not None:
+            kc, vc = constrain_kv(kc), constrain_kv(vc)
+        return logits, kc, vc
+
     def make_cache(batch, max_seq):
         kc, vc = make_empty_cache(c, batch, max_seq=max_seq)
         if mesh is not None:
@@ -94,6 +103,7 @@ def llama_engine(params: Any, model_config: LlamaConfig,
     return Engine(params, engine_config, prefill_fn=prefill_fn,
                   decode_fn=decode_fn, make_cache=make_cache,
                   prefill_chunk_fn=prefill_chunk_fn,
+                  spec_verify_fn=spec_verify_fn,
                   metrics=metrics, logger=logger)
 
 
