@@ -193,7 +193,6 @@ class Engine:
         self.config = config
         self.metrics = metrics
         self.logger = logger
-        self._prefill_raw = prefill_fn
         self._make_cache = make_cache
         # chunked prefill: long prompts in bucket-width chunks against
         # the growing cache (slot layout slices the cache; the paged
@@ -269,7 +268,7 @@ class Engine:
             self._decode = jax.jit(_decode_sample, donate_argnums=(2, 3))
         self._decode_k = K
         self._prefill_base_key = prefill_key
-        self._prefill_cache: dict[int, Callable] = {}
+        self._prefill_cache: dict[Any, Callable] = {}
         self._prefill_fn = prefill_fn
 
         self._failed: str | None = None
@@ -1119,6 +1118,25 @@ class Engine:
             if self._finished(req, first):
                 self._retire(req.slot)
 
+    def _retire_unservable(self) -> None:
+        """Shared pre-pass sweep: cancelled or at-ceiling slots leave
+        before any device compute (decode and verify passes alike)."""
+        for i, req in enumerate(self.active):
+            if req is not None and (req.cancelled
+                                    or self.lengths[i]
+                                    >= self.config.max_seq):
+                self._retire(i)
+
+    def _note_pass(self, stat_key: str, start: float) -> None:
+        """Per-device-pass accounting shared by decode and verify."""
+        elapsed = time.perf_counter() - start
+        self.stats[stat_key] += 1
+        self.stats["decode_s"] += elapsed
+        if self.metrics is not None:
+            self.metrics.record_histogram("app_tpu_execute_seconds",
+                                          elapsed)
+        self._step_count += 1
+
     def _finished(self, req: GenRequest, token: int) -> bool:
         if token == self.config.eos_id:
             return True
@@ -1142,14 +1160,10 @@ class Engine:
         cfg = self.config
         K = self._decode_k
         paged = cfg.kv_layout == "paged"
-        # slots with no headroom at all retire before the pass; slots
-        # with 1..K-1 rows of headroom run the pass and keep exactly
-        # the tokens whose cache writes landed (see valid below) — the
-        # cache ceiling truncates nothing anymore
-        for i, req in enumerate(self.active):
-            if req is not None and (req.cancelled
-                                    or self.lengths[i] >= cfg.max_seq):
-                self._retire(i)
+        # slots with 1..K-1 rows of headroom run the pass and keep
+        # exactly the tokens whose cache writes landed (see valid
+        # below) — the cache ceiling truncates nothing anymore
+        self._retire_unservable()
         if paged:
             # grow each slot's block table to cover this pass, evicting
             # the newest requests when the pool runs dry (they resume
@@ -1198,13 +1212,7 @@ class Engine:
             *tables, lengths, np.int32(self._rng_step), jnp.asarray(temps),
             jnp.asarray(top_ps), jnp.asarray(top_ks))
         step_np = np.asarray(step_tokens)  # [K, B]
-        self.stats["decode_passes"] += 1
-        self.stats["decode_s"] += time.perf_counter() - start
-        if self.metrics is not None:
-            self.metrics.record_histogram(
-                "app_tpu_execute_seconds", time.perf_counter() - start)
-
-        self._step_count += 1
+        self._note_pass("decode_passes", start)
         for i, req in enumerate(self.active):
             if req is None or req.pending_prefill:
                 continue
@@ -1318,12 +1326,7 @@ class Engine:
         a single decode step."""
         cfg = self.config
         paged = cfg.kv_layout == "paged"
-        # same pre-pass retirement contract as _decode_step: cancelled
-        # or at-ceiling slots leave before any compute
-        for i, req in enumerate(self.active):
-            if req is not None and (req.cancelled
-                                    or self.lengths[i] >= cfg.max_seq):
-                self._retire(i)
+        self._retire_unservable()
         width = cfg.spec_draft + 1
         b = cfg.max_batch
         tokens = np.zeros((b, width), np.int32)
@@ -1370,13 +1373,7 @@ class Engine:
             jnp.asarray(top_ks))
         accepted = np.asarray(accepted_dev)
         bonus = np.asarray(bonus_dev)
-        self.stats["spec_passes"] += 1
-        self.stats["decode_s"] += time.perf_counter() - start
-        if self.metrics is not None:
-            self.metrics.record_histogram(
-                "app_tpu_execute_seconds", time.perf_counter() - start)
-
-        self._step_count += 1
+        self._note_pass("spec_passes", start)
         for i, req in enumerate(self.active):
             if req is None or req.pending_prefill:
                 continue
