@@ -119,12 +119,14 @@ class SFTPWire(Instrumented):
     def __init__(self, host: str = "127.0.0.1", port: int = 22, *,
                  username: str = "", password: str = "",
                  expected_host_key: bytes | None = None,
+                 insecure_skip_host_key: bool = False,
                  timeout_s: float = 30.0) -> None:
         self.host = host
         self.port = port
         self.username = username
         self.password = password
         self.expected_host_key = expected_host_key
+        self.insecure_skip_host_key = insecure_skip_host_key
         self.timeout_s = timeout_s
         self._transport: SSHClientTransport | None = None
         self._channel = 0
@@ -141,9 +143,10 @@ class SFTPWire(Instrumented):
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         transport = SSHClientTransport(sock)
         try:
-            transport.handshake(username=self.username,
-                                password=self.password,
-                                expected_host_key=self.expected_host_key)
+            transport.handshake(
+                username=self.username, password=self.password,
+                expected_host_key=self.expected_host_key,
+                insecure_skip_host_key=self.insecure_skip_host_key)
             self._channel = transport.open_session_channel()
             transport.request_subsystem(self._channel, "sftp")
             self._transport = transport

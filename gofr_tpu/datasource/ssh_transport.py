@@ -222,12 +222,20 @@ class SSHTransport:
         if self._in is None:
             head = self.stream.exactly(4)
             (length,) = struct.unpack("!I", head)
+            if not 5 <= length <= 35000:  # RFC 4253 §6.1
+                raise SSHError(f"packet length {length} out of bounds")
             body = self.stream.exactly(length)
             self._in_seq += 1
             pad = body[0]
             return body[1:length - pad]
         head = self._in.dec.update(self.stream.exactly(16))
         (length,) = struct.unpack("!I", head[:4])
+        # bound before allocating: length is wire-supplied and the MAC
+        # is only checked after the remainder is read.  RFC 4253 §6.1:
+        # minimum total packet is one cipher block (16), i.e. a length
+        # field of 12, and receivers must handle up to 35000 total.
+        if not 12 <= length <= 35000:
+            raise SSHError(f"packet length {length} out of bounds")
         rest = self._in.dec.update(self.stream.exactly(length - 12))
         mac = self.stream.exactly(32)
         packet = head + rest
@@ -351,8 +359,19 @@ class SSHTransport:
 
 class SSHClientTransport(SSHTransport):
     def handshake(self, *, username: str, password: str,
-                  expected_host_key: bytes | None = None) -> None:
-        """Version exchange → kex → NEWKEYS → password auth."""
+                  expected_host_key: bytes | None = None,
+                  insecure_skip_host_key: bool = False) -> None:
+        """Version exchange → kex → NEWKEYS → password auth.
+
+        Host-key policy mirrors x/crypto/ssh's HostKeyCallback: the
+        caller must either pin ``expected_host_key`` or explicitly opt
+        in to an unauthenticated connection — the Ed25519 signature
+        alone only proves the peer owns *some* key, so a silent default
+        would hand the password to any man in the middle."""
+        if expected_host_key is None and not insecure_skip_host_key:
+            raise SSHError(
+                "no host key policy: pass expected_host_key=... or "
+                "insecure_skip_host_key=True (MITM-able; test only)")
         from cryptography.hazmat.primitives.asymmetric.x25519 import (
             X25519PrivateKey)
         from cryptography.hazmat.primitives.asymmetric.ed25519 import (

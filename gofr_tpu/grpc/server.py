@@ -131,6 +131,15 @@ class GRPCServer:
                           container=self.container)
             return ctx, metadata
 
+        async def recover(exc: Exception, start: float, grpc_ctx) -> None:
+            # recovery interceptor (grpc.go:98); handlers pick their
+            # status by setting exc.grpc_status, default INTERNAL
+            code = getattr(exc, "grpc_status", grpc.StatusCode.INTERNAL)
+            logger.error(f"grpc panic in {full_method}: {exc!r}",
+                         stack=traceback.format_exc())
+            observe(start, code.name)
+            await grpc_ctx.abort(code, str(exc) or "internal error")
+
         async def call_unary(request_bytes_decoded, grpc_ctx):
             start = time.perf_counter()
             ctx, metadata = make_ctx(request_bytes_decoded, grpc_ctx)
@@ -145,12 +154,8 @@ class GRPCServer:
             except asyncio.CancelledError:
                 observe(start, "CANCELLED")
                 raise
-            except Exception as exc:  # recovery interceptor (grpc.go:98)
-                logger.error(f"grpc panic in {full_method}: {exc!r}",
-                             stack=traceback.format_exc())
-                observe(start, "INTERNAL")
-                await grpc_ctx.abort(grpc.StatusCode.INTERNAL,
-                                     str(exc) or "internal error")
+            except Exception as exc:
+                await recover(exc, start, grpc_ctx)
             finally:
                 span.end()
 
@@ -167,11 +172,7 @@ class GRPCServer:
                 observe(start, "CANCELLED")
                 raise
             except Exception as exc:
-                logger.error(f"grpc panic in {full_method}: {exc!r}",
-                             stack=traceback.format_exc())
-                observe(start, "INTERNAL")
-                await grpc_ctx.abort(grpc.StatusCode.INTERNAL,
-                                     str(exc) or "internal error")
+                await recover(exc, start, grpc_ctx)
             finally:
                 span.end()
 

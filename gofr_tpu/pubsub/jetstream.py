@@ -24,6 +24,7 @@ stream/consumer engine so the same bytes work hermetically.
 from __future__ import annotations
 
 import asyncio
+import base64
 import itertools
 import json
 import time
@@ -81,15 +82,27 @@ class JetStreamClient(NATSClient):
         await super()._reconnect()
 
     # ------------------------------------------------------ request/reply
-    async def _request(self, subject: str, payload: bytes) -> bytes:
-        """Core NATS request-reply over a one-shot inbox."""
+    async def _request(self, subject: str, payload: bytes,
+                       headers: dict | None = None) -> bytes:
+        """Core NATS request-reply over a one-shot inbox.  With
+        ``headers`` the request goes out as HPUB (NATS 2.2 header
+        frame: ``NATS/1.0\\r\\n<K: V>...\\r\\n\\r\\n`` prefix)."""
         await self._ensure_connected()
         inbox = f"{self._inbox_prefix}.{next(self._inbox_seq)}"
         sid = await self._ensure_sub(inbox, "")
         try:
             writer = self._require_writer()
-            writer.write(f"PUB {subject} {inbox} {len(payload)}\r\n"
-                         .encode() + payload + b"\r\n")
+            if headers:
+                hdr = ("NATS/1.0\r\n"
+                       + "".join(f"{k}: {v}\r\n" for k, v in headers.items())
+                       + "\r\n").encode()
+                writer.write(
+                    f"HPUB {subject} {inbox} {len(hdr)} "
+                    f"{len(hdr) + len(payload)}\r\n".encode()
+                    + hdr + payload + b"\r\n")
+            else:
+                writer.write(f"PUB {subject} {inbox} {len(payload)}\r\n"
+                             .encode() + payload + b"\r\n")
             await writer.drain()
             item = await asyncio.wait_for(self._queues[sid].get(),
                                           self.request_timeout_s)
@@ -219,7 +232,8 @@ class _Stream:
     def __init__(self, name: str, subjects: list[str]) -> None:
         self.name = name
         self.subjects = subjects
-        self.messages: list[bytes] = []       # seq i+1 -> messages[i]
+        #: seq i+1 -> (subject, payload, raw header block or b"")
+        self.messages: list[tuple[str, bytes, bytes]] = []
 
 
 class _Consumer:
@@ -242,8 +256,8 @@ class MiniJetStreamServer(MiniNATSServer):
         self.streams: dict[str, _Stream] = {}
         self.consumers: dict[tuple[str, str], _Consumer] = {}
 
-    async def _publish(self, subject: str, reply: str,
-                       payload: bytes) -> None:
+    async def _publish(self, subject: str, reply: str, payload: bytes,
+                       hdrs: bytes = b"") -> None:
         if subject.startswith(JS_API + "."):
             await self._handle_api(subject[len(JS_API) + 1:], reply,
                                    payload)
@@ -254,7 +268,7 @@ class MiniJetStreamServer(MiniNATSServer):
         stored = None
         for stream in self.streams.values():
             if any(subject_matches(p, subject) for p in stream.subjects):
-                stream.messages.append(payload)
+                stream.messages.append((subject, payload, hdrs))
                 stored = (stream.name, len(stream.messages))
         if stored and reply:
             await self._route(reply, json.dumps(
@@ -300,9 +314,39 @@ class MiniJetStreamServer(MiniNATSServer):
             ack_subject = (f"$JS.ACK.{stream}.{durable}.1.{seq}.{seq}."
                            f"{int(time.time())}.0")
             await self._route(reply,
-                              self.streams[stream].messages[seq - 1],
+                              self.streams[stream].messages[seq - 1][1],
                               reply=ack_subject)
             return
+        elif op.startswith("STREAM.MSG.GET."):
+            # direct get: {"seq": n} | {"last_by_subj": subject} — the
+            # JetStream API the KV facade's reads ride on
+            name = op.rsplit(".", 1)[-1]
+            stream_obj = self.streams.get(name)
+            if stream_obj is None:
+                out = {"error": {"code": 404,
+                                 "description": "stream not found"}}
+            else:
+                found = None
+                if "last_by_subj" in body:
+                    want = body["last_by_subj"]
+                    for i in range(len(stream_obj.messages) - 1, -1, -1):
+                        if stream_obj.messages[i][0] == want:
+                            found = (i + 1, stream_obj.messages[i])
+                            break
+                elif "seq" in body:
+                    seq = int(body["seq"])
+                    if 1 <= seq <= len(stream_obj.messages):
+                        found = (seq, stream_obj.messages[seq - 1])
+                if found is None:
+                    out = {"error": {"code": 404,
+                                     "description": "no message found"}}
+                else:
+                    seq, (subj, payload, hdrs) = found
+                    msg = {"subject": subj, "seq": seq,
+                           "data": base64.b64encode(payload).decode()}
+                    if hdrs:
+                        msg["hdrs"] = base64.b64encode(hdrs).decode()
+                    out = {"message": msg}
         else:
             out = {"error": {"code": 400, "description": f"bad op {op}"}}
         if reply:

@@ -91,7 +91,8 @@ class NATSClient:
             raise NATSError(f"expected INFO, got {line[:40]!r}")
         self._server_info = json.loads(line[5:])
         options = {"verbose": False, "pedantic": False, "name": self.name,
-                   "lang": "python", "version": "1", "protocol": 1}
+                   "lang": "python", "version": "1", "protocol": 1,
+                   "headers": True}  # NATS 2.2+: permits HPUB/HMSG
         self._writer.write(f"CONNECT {json.dumps(options)}\r\nPING\r\n"
                            .encode())
         await self._writer.drain()
@@ -120,6 +121,23 @@ class NATSClient:
                     queue = self._queues.get(sid)
                     if queue is not None:
                         await queue.put((subject, reply, payload))
+                elif line.startswith(b"HMSG "):
+                    # HMSG <subject> <sid> [reply-to] <#hdr> <#total> —
+                    # headered delivery (we advertise headers:true, so a
+                    # real 2.2+ server may send these, e.g. 503 "no
+                    # responders" status replies or KV tombstones)
+                    parts = line[5:].strip().split(b" ")
+                    subject = parts[0].decode()
+                    sid = int(parts[1])
+                    reply = parts[2].decode() if len(parts) == 5 else ""
+                    hdr_len, total = int(parts[-2]), int(parts[-1])
+                    blob = await self._reader.readexactly(total)
+                    await self._reader.readexactly(2)
+                    queue = self._queues.get(sid)
+                    if queue is not None:
+                        # headers are transport detail at this layer;
+                        # deliver the payload (empty for status frames)
+                        await queue.put((subject, reply, blob[hdr_len:]))
                 elif line.startswith(b"PING"):
                     if self._writer is not None:
                         self._writer.write(b"PONG\r\n")
@@ -300,15 +318,25 @@ class MiniNATSServer:
                     payload = await reader.readexactly(nbytes)
                     await reader.readexactly(2)
                     await self._publish(subject, reply, payload)
+                elif verb == b"HPUB":
+                    # HPUB <subject> [reply-to] <#hdr-bytes> <#total-bytes>
+                    parts = line.decode().strip().split()
+                    subject = parts[1]
+                    reply = parts[2] if len(parts) == 5 else ""
+                    hdr_len, total = int(parts[-2]), int(parts[-1])
+                    blob = await reader.readexactly(total)
+                    await reader.readexactly(2)
+                    await self._publish(subject, reply, blob[hdr_len:],
+                                        hdrs=blob[:hdr_len])
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
         finally:
             self._conns.pop(conn_id, None)
             self._subs = [s for s in self._subs if s[0] != conn_id]
 
-    async def _publish(self, subject: str, reply: str,
-                       payload: bytes) -> None:
-        """One inbound PUB; the JetStream subclass intercepts API
+    async def _publish(self, subject: str, reply: str, payload: bytes,
+                       hdrs: bytes = b"") -> None:
+        """One inbound PUB/HPUB; the JetStream subclass intercepts API
         subjects and stream captures here."""
         await self._route(subject, payload, reply=reply)
 

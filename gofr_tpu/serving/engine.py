@@ -555,15 +555,6 @@ class Engine:
             if req.finished_at is None:
                 self.cancel(req)
 
-    async def generate_stream(self, prompt_tokens: list[int],
-                              params: SamplingParams | None = None):
-        """Submit + stream in one call (raises nothing on overload —
-        the stream just ends; handlers that need a 503 submit first
-        and check ``req.error``)."""
-        req = self.submit(prompt_tokens, params)
-        async for token in self.stream_request(req):
-            yield token
-
     # ---------------------------------------------------------- scheduling
     def _group_sizes(self) -> tuple:
         """Compiled prefill group sizes: powers of two up to

@@ -19,6 +19,8 @@ from __future__ import annotations
 import time
 from typing import Any, AsyncIterator
 
+import grpc
+
 from ..grpc.service import GRPCService, rpc, server_stream_rpc
 from .engine import Engine, SamplingParams
 
@@ -51,13 +53,23 @@ def make_chat_service(engine: Engine, tokenizer: Any) -> GRPCService:
             prompt, params = _params_from(request or {})
             prompt_tokens = tokenizer.encode(prompt)
             start = time.perf_counter()
+            req = engine.submit(prompt_tokens, params)
+            if req.error:
+                # admission refused: distinct status, not INTERNAL
+                exc = RuntimeError(req.error)
+                exc.grpc_status = grpc.StatusCode.RESOURCE_EXHAUSTED
+                raise exc
             n = 0
-            gen = engine.generate_stream(prompt_tokens, params)
+            gen = engine.stream_request(req)
             try:
                 async for token in gen:
                     n += 1
                     yield {"token": token,
                            "text": tokenizer.decode([token])}
+                if req.error:
+                    # mid-generation failure (kv loss, shutdown): the
+                    # client must not mistake truncation for completion
+                    raise RuntimeError(f"generation failed: {req.error}")
                 yield {"done": True,
                        "usage": {"prompt_tokens": len(prompt_tokens),
                                  "completion_tokens": n,
@@ -75,6 +87,11 @@ def make_chat_service(engine: Engine, tokenizer: Any) -> GRPCService:
             prompt, params = _params_from(request or {})
             prompt_tokens = tokenizer.encode(prompt)
             req = engine.submit(prompt_tokens, params)
+            if req.error:
+                # same overload condition, same status as Stream
+                exc = RuntimeError(req.error)
+                exc.grpc_status = grpc.StatusCode.RESOURCE_EXHAUSTED
+                raise exc
             tokens: list[int] = []
             while True:
                 token = await req.out_queue.get()

@@ -61,7 +61,13 @@ def make_chat_handler(engine: Engine, tokenizer: Any):
                         yield ("data: "
                                + json.dumps({"token": token, "text": text})
                                + "\n\n")
-                    yield "data: [DONE]\n\n"
+                    if req.error:
+                        # mid-generation failure (kv loss, shutdown):
+                        # truncation must be visible — no [DONE]
+                        yield ("data: "
+                               + json.dumps({"error": req.error}) + "\n\n")
+                    else:
+                        yield "data: [DONE]\n\n"
                 finally:
                     # deterministic: closing THIS generator (client
                     # gone) must close the engine stream too, which

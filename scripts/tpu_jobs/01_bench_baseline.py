@@ -1,0 +1,6 @@
+"""TPU job: run the standard bench pinned to the TPU platform."""
+import os
+import runpy
+
+os.environ["GOFR_BENCH_PLATFORM"] = "tpu"
+runpy.run_path("bench.py", run_name="__main__")

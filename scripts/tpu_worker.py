@@ -1,0 +1,137 @@
+"""Persistent TPU probe + job-queue worker.
+
+The axon TPU tunnel intermittently hangs forever at backend init, so a
+single probe at bench time is not enough persistence.  This worker runs
+for the whole round in the background:
+
+  * every PROBE_INTERVAL_S it probes the TPU backend in a bounded,
+    fresh subprocess (never inline — a hung init would wedge the loop);
+  * when the probe succeeds, it drains `scripts/tpu_queue/*.py` in
+    lexical order, running each job in its own bounded subprocess with
+    the TPU backend, writing stdout/stderr + rc to
+    `scripts/tpu_results/<job>.json`, and moving the job file to
+    `scripts/tpu_done/`;
+  * all probe attempts and outcomes append to `scripts/tpu_state.jsonl`
+    so the session can check tunnel health at a glance.
+
+Jobs are plain python scripts run with cwd=repo root; they should print
+whatever artifact they produce (one JSON line by convention).  A job
+that times out or crashes is moved to tpu_done with ok=false — re-queue
+by copying it back.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+QUEUE = os.path.join(HERE, "tpu_queue")
+DONE = os.path.join(HERE, "tpu_done")
+RESULTS = os.path.join(HERE, "tpu_results")
+STATE = os.path.join(HERE, "tpu_state.jsonl")
+
+PROBE_INTERVAL_S = int(os.environ.get("GOFR_TPU_PROBE_INTERVAL", "240"))
+PROBE_TIMEOUT_S = int(os.environ.get("GOFR_TPU_PROBE_TIMEOUT", "180"))
+JOB_TIMEOUT_S = int(os.environ.get("GOFR_TPU_JOB_TIMEOUT", "1800"))
+MAX_RUNTIME_S = int(os.environ.get("GOFR_TPU_WORKER_MAX_S", str(11 * 3600)))
+
+PROBE_CODE = """
+import jax
+d = jax.devices()
+print("PROBE_OK", jax.default_backend(), len(d), d[0].device_kind)
+"""
+
+
+def _env_tpu() -> dict:
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["GOFR_TELEMETRY"] = "false"
+    return env
+
+
+def _log(rec: dict) -> None:
+    rec["ts"] = round(time.time(), 1)
+    with open(STATE, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+
+
+def _probe() -> dict | None:
+    """Return {"backend","n","kind"} on success, else None."""
+    try:
+        p = subprocess.run([sys.executable, "-c", PROBE_CODE], env=_env_tpu(),
+                           capture_output=True, text=True,
+                           timeout=PROBE_TIMEOUT_S, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        _log({"event": "probe", "ok": False, "why": f"timeout {PROBE_TIMEOUT_S}s"})
+        return None
+    toks = p.stdout.split()
+    if p.returncode == 0 and "PROBE_OK" in toks:
+        i = toks.index("PROBE_OK")
+        backend, n = toks[i + 1], int(toks[i + 2])
+        kind = " ".join(toks[i + 3:])
+        if backend != "cpu":
+            _log({"event": "probe", "ok": True, "backend": backend,
+                  "n": n, "kind": kind})
+            return {"backend": backend, "n": n, "kind": kind}
+        _log({"event": "probe", "ok": False, "why": "cpu-only backend"})
+        return None
+    tail = (p.stderr or p.stdout).strip().splitlines()[-1:] or ["?"]
+    _log({"event": "probe", "ok": False, "why": f"rc={p.returncode} {tail[0][:200]}"})
+    return None
+
+
+def _run_job(path: str) -> None:
+    name = os.path.basename(path)
+    _log({"event": "job_start", "job": name})
+    t0 = time.time()
+    try:
+        p = subprocess.run([sys.executable, path], env=_env_tpu(),
+                           capture_output=True, text=True,
+                           timeout=JOB_TIMEOUT_S, cwd=REPO)
+        rc, out, err = p.returncode, p.stdout, p.stderr
+    except subprocess.TimeoutExpired as e:
+        rc = None
+        out = e.stdout.decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        err = (e.stderr.decode() if isinstance(e.stderr, bytes) else (e.stderr or "")) \
+            + f"\n[timeout after {JOB_TIMEOUT_S}s]"
+    wall = round(time.time() - t0, 1)
+    result = {"job": name, "ok": rc == 0, "rc": rc, "wall_s": wall,
+              "stdout": out[-20000:], "stderr": err[-8000:],
+              "ts": round(time.time(), 1)}
+    with open(os.path.join(RESULTS, name + ".json"), "w") as f:
+        json.dump(result, f, indent=1)
+    shutil.move(path, os.path.join(DONE, name))
+    _log({"event": "job_done", "job": name, "ok": rc == 0, "wall_s": wall})
+
+
+def main() -> None:
+    for d in (QUEUE, DONE, RESULTS):
+        os.makedirs(d, exist_ok=True)
+    t_start = time.time()
+    _log({"event": "worker_start", "pid": os.getpid()})
+    while time.time() - t_start < MAX_RUNTIME_S:
+        jobs = sorted(f for f in os.listdir(QUEUE) if f.endswith(".py"))
+        drained = False
+        if jobs and _probe() is not None:
+            # tunnel healthy right now — drain as much as we can while
+            # it lasts; each job re-checks implicitly by failing fast
+            for name in jobs:
+                path = os.path.join(QUEUE, name)
+                if os.path.exists(path):
+                    _run_job(path)
+                    drained = True
+        # only hurry when the tunnel just proved healthy; a failed
+        # probe already burned PROBE_TIMEOUT_S — don't hammer it
+        time.sleep(30 if drained else PROBE_INTERVAL_S)
+    _log({"event": "worker_exit"})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,114 @@
+"""NATS-KV over JetStream buckets (reference datasource/kv-store/nats):
+set = stream capture, get = direct last_by_subj, delete = KV-Operation
+DEL tombstone via HPUB — real bytes against the mini JetStream server."""
+
+import asyncio
+import threading
+
+import pytest
+
+from gofr_tpu.datasource.kv import KeyNotFound, KVError
+from gofr_tpu.datasource.nats_kv import NATSKV
+from gofr_tpu.pubsub.jetstream import MiniJetStreamServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+    srv = MiniJetStreamServer()
+    asyncio.run_coroutine_threadsafe(srv.start(), loop).result(10)
+    yield srv
+    asyncio.run_coroutine_threadsafe(srv.close(), loop).result(10)
+    loop.call_soon_threadsafe(loop.stop)
+    thread.join(5)
+
+
+@pytest.fixture()
+def kv(server):
+    store = NATSKV(port=server.port, bucket="app")
+    store.connect()
+    yield store
+    store.close()
+
+
+def test_set_get_roundtrip(kv):
+    kv.set("greeting", "hello")
+    assert kv.get("greeting") == "hello"
+    kv.set("greeting", "hello again")        # last write wins
+    assert kv.get("greeting") == "hello again"
+
+
+def test_missing_key(kv):
+    with pytest.raises(KeyNotFound):
+        kv.get("never-written")
+
+
+def test_delete_writes_tombstone(kv, server):
+    kv.set("doomed", "v")
+    assert kv.get("doomed") == "v"
+    kv.delete("doomed")
+    with pytest.raises(KeyNotFound):
+        kv.get("doomed")
+    # the tombstone is a real message with the KV-Operation header —
+    # deletion without destroying history (nats KV semantics)
+    subject, payload, hdrs = server.streams["KV_app"].messages[-1]
+    assert subject == "$KV.app.doomed"
+    assert payload == b""
+    assert b"KV-Operation: DEL" in hdrs
+    # and the key is writable again afterwards
+    kv.set("doomed", "reborn")
+    assert kv.get("doomed") == "reborn"
+
+
+def test_dotted_keys_are_distinct(kv):
+    kv.set("cfg.db.host", "a")
+    kv.set("cfg.db.port", "b")
+    assert kv.get("cfg.db.host") == "a"
+    assert kv.get("cfg.db.port") == "b"
+
+
+def test_invalid_names_rejected(server):
+    with pytest.raises(KVError):
+        NATSKV(port=server.port, bucket="has.dot")
+    store = NATSKV(port=server.port, bucket="ok")
+    store.connect()
+    try:
+        for bad in ("", "a b", "star*", ".leading", "trailing."):
+            with pytest.raises(KVError):
+                store.set(bad, "x")
+    finally:
+        store.close()
+
+
+def test_buckets_are_isolated(server):
+    a = NATSKV(port=server.port, bucket="tenant_a")
+    b = NATSKV(port=server.port, bucket="tenant_b")
+    a.connect()
+    b.connect()
+    try:
+        a.set("k", "from-a")
+        b.set("k", "from-b")
+        assert a.get("k") == "from-a"
+        assert b.get("k") == "from-b"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_health_and_container_wiring(server):
+    from gofr_tpu.config.env import DictConfig
+    from gofr_tpu.container.container import Container
+
+    container = Container(DictConfig({"APP_NAME": "kvtest"}))
+    store = container.add_kv_store(NATSKV(port=server.port, bucket="health"))
+    store.connect()
+    try:
+        store.set("k", "v")
+        assert store.get("k") == "v"
+        assert store.health_check()["status"] == "UP"
+        assert container.kv is store
+    finally:
+        store.close()
+    assert store.health_check()["status"] == "DOWN"

@@ -101,6 +101,36 @@ def test_stream_client_disconnect_cancels_request(serving_app):
     assert json.loads(data)["data"]["usage"]["completion_tokens"] == 3
 
 
+def test_stream_engine_failure_visible_in_sse():
+    """A stream cut short by an engine failure (shutdown, kv loss)
+    must end with an error event, never the [DONE] sentinel — clients
+    cannot be allowed to mistake truncation for completion."""
+    import http.client
+    import threading
+
+    tokenizer = ByteTokenizer()
+    engine = demo_llama_engine(EngineConfig(max_batch=2, max_seq=128))
+    engine.start()
+    try:
+        with AppRunner() as runner:
+            runner.app.post("/chat", make_chat_handler(engine, tokenizer))
+            conn = http.client.HTTPConnection("127.0.0.1", runner.port,
+                                              timeout=30)
+            body = json.dumps({"prompt": "doomed stream", "stream": True,
+                               "temperature": 0.0, "max_tokens": 4096})
+            conn.request("POST", "/chat", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read(64)  # generation started
+            threading.Thread(target=engine.stop, daemon=True).start()
+            rest = resp.read().decode()
+            conn.close()
+        assert "[DONE]" not in rest
+        assert '"error"' in rest and "engine stopped" in rest
+    finally:
+        engine.stop()
+
+
 def test_overloaded_engine_returns_503():
     """With max_waiting bounded, a flood beyond slots+queue gets an
     immediate 503 instead of joining an ever-slower queue."""

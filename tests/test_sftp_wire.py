@@ -83,7 +83,8 @@ def test_path_jail(fs, server):
 
 def test_wrong_password_rejected(server):
     bad = SFTPWire(host="127.0.0.1", port=server.port,
-                   username="app", password="WRONG")
+                   username="app", password="WRONG",
+                   insecure_skip_host_key=True)
     with pytest.raises(SSHAuthError):
         bad.connect()
 
@@ -94,6 +95,15 @@ def test_host_key_pinning_detects_mitm(server):
                       expected_host_key=b"\x00" * 32)
     with pytest.raises(SSHError, match="host key mismatch"):
         pinned.connect()
+
+
+def test_no_host_key_policy_refused(server):
+    """x/crypto/ssh-style contract: connecting without a pinned host
+    key requires an explicit insecure opt-in."""
+    lax = SFTPWire(host="127.0.0.1", port=server.port,
+                   username="app", password="s3cr3t")
+    with pytest.raises(SSHError, match="host key policy"):
+        lax.connect()
 
 
 def test_paramiko_style_aliases(fs):
@@ -111,7 +121,8 @@ def test_injected_into_existing_sftp_filesystem(server):
     from gofr_tpu.datasource.ftp import SFTPFileSystem
 
     wire = SFTPWire(host="127.0.0.1", port=server.port,
-                    username="app", password="s3cr3t")
+                    username="app", password="s3cr3t",
+                    insecure_skip_host_key=True)
     wire.connect()
     fs = SFTPFileSystem(client=wire)
     fs.connect()
