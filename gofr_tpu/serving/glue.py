@@ -100,10 +100,28 @@ def llama_engine(params: Any, model_config: LlamaConfig,
             vc = jax.device_put(vc, sharding)
         return kc, vc
 
+    paged_decode_fn = None
+    if engine_config.kv_layout == "paged" and mesh is None:
+        # native paged decode: rows written through the block table,
+        # ragged paged-attention kernel reads pages in place — no
+        # per-pass view materialisation. (The mesh path keeps the
+        # view: the kernel is single-device; tp-sharding it is future
+        # work and the view path already shards.)
+        from ..models.llama import llama_decode_step_paged
+        impl = {"kernel": "pallas", "interpret": "interpret",
+                "xla": "xla"}.get(engine_config.paged_attention, "auto")
+
+        def paged_decode_fn(params, tokens, k_pool, v_pool, tables,
+                            lengths):
+            return llama_decode_step_paged(params, tokens, k_pool,
+                                           v_pool, tables, lengths, c,
+                                           implementation=impl)
+
     return Engine(params, engine_config, prefill_fn=prefill_fn,
                   decode_fn=decode_fn, make_cache=make_cache,
                   prefill_chunk_fn=prefill_chunk_fn,
                   spec_verify_fn=spec_verify_fn,
+                  paged_decode_fn=paged_decode_fn,
                   metrics=metrics, logger=logger)
 
 

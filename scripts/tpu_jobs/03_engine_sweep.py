@@ -1,0 +1,113 @@
+"""TPU job: serving-engine saturation sweep on the 1B bench model.
+
+Sweeps max_batch x K (decode_steps_per_pass) and kv layout on the real
+chip, recording tok/s, req/s, p50 TTFT, phase attribution, MFU and the
+HBM decode roofline per point (VERDICT r3 #2/#4). One JSON line at the
+end carries every point; intermediate lines stream per point so a
+tunnel death mid-sweep still leaves data.
+"""
+
+import json
+import statistics
+import time
+
+import jax
+import numpy as np
+
+assert jax.default_backend() != "cpu", "TPU job ran on CPU"
+
+from gofr_tpu.models.llama import LlamaConfig, llama_init, param_count
+from gofr_tpu.serving.engine import EngineConfig, SamplingParams
+from gofr_tpu.serving.glue import llama_engine
+
+DEV = jax.devices()[0].device_kind
+PEAK_FLOPS = {"TPU v5 lite": 197e12, "TPU v5": 459e12, "TPU v5p": 459e12,
+              "TPU v4": 275e12, "TPU v6 lite": 918e12}
+HBM_GBS = {"TPU v5 lite": 819, "TPU v5": 2765, "TPU v5p": 2765,
+           "TPU v4": 1228, "TPU v6 lite": 1640}
+peak = next((v for kname, v in sorted(PEAK_FLOPS.items(),
+                                      key=lambda kv: -len(kv[0]))
+             if DEV.startswith(kname)), None)
+hbm = next((v for kname, v in sorted(HBM_GBS.items(),
+                                     key=lambda kv: -len(kv[0]))
+            if DEV.startswith(kname)), None)
+
+config = LlamaConfig.llama3_1b().scaled(max_seq=1024)
+params = llama_init(jax.random.key(0), config)
+jax.block_until_ready(params)
+n_params = param_count(params)
+# decode roofline: each generated token must stream every parameter
+# (2 bytes bf16) + the request's KV rows; params dominate at this
+# scale, so tokens/s <= HBM_bw / (2 * n_params / batch) per batch row
+param_bytes = 2.0 * n_params
+
+points = []
+
+
+def run_point(max_batch, k_steps, layout, n_requests=None,
+              prompt_len=64, gen_len=64, paged_attention="auto"):
+    n_requests = n_requests or max_batch * 4
+    eng_cfg = EngineConfig(
+        max_batch=max_batch, max_seq=config.max_seq,
+        prefill_buckets=(64, 128, 256, 512), seed=0,
+        decode_steps_per_pass=k_steps, kv_layout=layout,
+        page_size=64, paged_attention=paged_attention)
+    engine = llama_engine(params, config, eng_cfg)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=gen_len)
+    prompt = list(range(1, prompt_len + 1))
+    engine.warmup(prompt_lens=(prompt_len,))
+    engine.start()
+    engine.stats = {k: 0 if isinstance(v, int) else 0.0
+                    for k, v in engine.stats.items()}
+    t0 = time.time()
+    reqs = [engine.submit(prompt, sp) for _ in range(n_requests)]
+    while any(r.finished_at is None and r.error is None for r in reqs):
+        time.sleep(0.005)
+    wall = time.time() - t0
+    stats = dict(engine.stats)
+    engine.stop()
+    ok = [r for r in reqs if r.error is None]
+    toks = sum(len(r.generated) for r in ok)
+    ttfts = sorted(r.ttft_ms for r in ok if r.ttft_ms is not None)
+    flops = 2.0 * n_params * ((toks - len(ok)) + len(ok) * prompt_len)
+    decode_s = stats["decode_s"]
+    decode_toks = toks - len(ok)
+    # roofline: in pure decode the pass streams all params once per
+    # K-step x batch tokens — the bound this point is judged against
+    roof_toks = (hbm * 1e9) / (param_bytes / max_batch) if hbm else None
+    point = {
+        "layout": layout, "paged_attention": paged_attention,
+        "max_batch": max_batch, "k": k_steps,
+        "n_requests": n_requests, "ok": len(ok), "wall_s": round(wall, 2),
+        "tok_per_s": round(toks / wall, 1),
+        "req_per_s": round(len(ok) / wall, 2),
+        "p50_ttft_ms": round(statistics.median(ttfts), 1) if ttfts else -1,
+        "p99_ttft_ms": round(ttfts[int(0.99 * (len(ttfts) - 1))], 1)
+        if ttfts else -1,
+        "mfu": round(flops / (wall * peak), 4) if peak else None,
+        "decode_tok_per_s": round(decode_toks / decode_s, 1)
+        if decode_s > 0 else None,
+        "roofline_tok_per_s": round(roof_toks, 1) if roof_toks else None,
+        "pct_of_roofline": round(100 * (toks / wall) / roof_toks, 1)
+        if roof_toks else None,
+        "phases": {k: (round(v, 2) if isinstance(v, float) else v)
+                   for k, v in stats.items()},
+    }
+    points.append(point)
+    print("POINT " + json.dumps(point), flush=True)
+    return point
+
+
+# batch sweep at K=8, slot layout (the r02 configuration, now pipelined)
+for mb in (16, 32, 64):
+    run_point(mb, 8, "slot")
+# K sweep
+for k in (16, 32):
+    run_point(32, k, "slot")
+# paged: gather/scatter view path vs the native ragged kernel path
+run_point(32, 8, "paged", paged_attention="view")
+run_point(32, 8, "paged", paged_attention="kernel")
+
+print("RESULT_JSON " + json.dumps({
+    "job": "engine_sweep", "device": DEV, "n_params": n_params,
+    "peak_flops": peak, "hbm_gbs": hbm, "points": points}))

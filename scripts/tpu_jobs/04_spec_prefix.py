@@ -1,0 +1,81 @@
+"""TPU job: measure speculative decoding + prefix cache (VERDICT r3 #6).
+
+Workload: repeated system prompt + greedy generation (the regime both
+features exist for). Reports acceptance rate, tokens/pass, tok/s and
+TTFT deltas vs vanilla, on the real chip. One JSON line.
+"""
+
+import json
+import statistics
+import time
+
+import jax
+
+assert jax.default_backend() != "cpu", "TPU job ran on CPU"
+
+from gofr_tpu.models.llama import LlamaConfig, llama_init
+from gofr_tpu.serving.engine import EngineConfig, SamplingParams
+from gofr_tpu.serving.glue import llama_engine
+
+config = LlamaConfig.llama3_1b().scaled(max_seq=1024)
+params = llama_init(jax.random.key(0), config)
+jax.block_until_ready(params)
+
+SYSTEM = list(range(1, 257))          # 256-token shared system prompt
+N_REQ, GEN = 32, 64
+
+
+def run(name, **cfg_kw):
+    eng_cfg = EngineConfig(max_batch=16, max_seq=config.max_seq,
+                           prefill_buckets=(64, 128, 256, 512), seed=0,
+                           **cfg_kw)
+    engine = llama_engine(params, config, eng_cfg)
+    engine.warmup(prompt_lens=(320,))
+    engine.start()
+    engine.stats = {k: 0 if isinstance(v, int) else 0.0
+                    for k, v in engine.stats.items()}
+    sp = SamplingParams(temperature=0.0, max_new_tokens=GEN)
+    t0 = time.time()
+    reqs = [engine.submit(SYSTEM + [1000 + i, 7, 3], sp)
+            for i in range(N_REQ)]
+    while any(r.finished_at is None and r.error is None for r in reqs):
+        time.sleep(0.005)
+    wall = time.time() - t0
+    stats = dict(engine.stats)
+    engine.stop()
+    ok = [r for r in reqs if r.error is None]
+    toks = sum(len(r.generated) for r in ok)
+    ttfts = sorted(r.ttft_ms for r in ok if r.ttft_ms is not None)
+    out = {
+        "scenario": name, "ok": len(ok), "wall_s": round(wall, 2),
+        "tok_per_s": round(toks / wall, 1),
+        "p50_ttft_ms": round(statistics.median(ttfts), 1) if ttfts else -1,
+        "spec_passes": stats.get("spec_passes", 0),
+        "spec_accepted": stats.get("spec_accepted", 0),
+        "decode_passes": stats.get("decode_passes", 0),
+        "prefix_hits": stats.get("prefix_hits", 0),
+        "prefill_calls": stats.get("prefill_calls", 0),
+    }
+    if out["spec_passes"]:
+        # accepted drafts + the always-emitted bonus token per pass
+        out["tokens_per_spec_pass"] = round(
+            (out["spec_accepted"] + out["spec_passes"])
+            / out["spec_passes"], 2)
+        out["acceptance_rate"] = round(
+            out["spec_accepted"]
+            / (out["spec_passes"] * eng_cfg.spec_draft), 3)
+    print("POINT " + json.dumps(out), flush=True)
+    return out
+
+
+results = [
+    run("vanilla_slot", kv_layout="slot"),
+    run("speculative", kv_layout="slot", speculative=True),
+    run("paged_prefix_cache", kv_layout="paged", page_size=64,
+        prefix_cache=True),
+    run("paged_no_prefix", kv_layout="paged", page_size=64,
+        prefix_cache=False),
+]
+print("RESULT_JSON " + json.dumps({
+    "job": "spec_prefix", "device": jax.devices()[0].device_kind,
+    "scenarios": results}))

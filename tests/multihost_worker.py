@@ -1,0 +1,100 @@
+"""Worker-process entry for the multi-process multi-host test.
+
+Runs as a REAL OS process (``python tests/multihost_worker.py``): joins
+the control-plane leader over HTTP, and depending on GOFR_MODE either
+
+- ``jax``: waits for the expected world size, calls
+  ``jax.distributed.initialize(**assignment.jax_initialize_args())``
+  (the SURVEY §4 hand-off this harness exists to prove), verifies the
+  global process/device view, attempts one cross-process collective,
+  prints evidence as JSON lines, and exits; or
+- ``plain``: joins and heartbeats forever (the test kills it to drive
+  eviction), printing every assignment change.
+
+Configuration via env: GOFR_LEADER_URL, GOFR_HOST_ID, GOFR_MODE,
+GOFR_EXPECT_WORLD.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def emit(**kw):
+    print("EV " + json.dumps(kw), flush=True)
+
+
+def main() -> None:
+    leader_url = os.environ["GOFR_LEADER_URL"]
+    host_id = os.environ["GOFR_HOST_ID"]
+    mode = os.environ.get("GOFR_MODE", "plain")
+    expect_world = int(os.environ.get("GOFR_EXPECT_WORLD", "2"))
+
+    if mode == "jax":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from gofr_tpu.serving.control_plane import WorkerAgent
+
+    changes = []
+    agent = WorkerAgent(leader_url, host_id=host_id,
+                        address=f"proc:{os.getpid()}", n_devices=1,
+                        heartbeat_interval_s=0.3,
+                        on_assignment=lambda a: changes.append(a))
+    assignment = agent.join()
+    emit(event="joined", **assignment.to_dict())
+
+    if mode == "plain":
+        agent.start()
+        while True:                    # killed by the test
+            time.sleep(0.2)
+            if len(changes) > 1:
+                emit(event="assignment_changed",
+                     **changes[-1].to_dict())
+                changes = changes[:1]
+
+    # jax mode: wait until the whole group has joined, refresh the
+    # assignment at the settled generation, then hand off to the SPMD
+    # runtime exactly the way a serving host would
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        assignment, _changed = agent.heartbeat_sync()
+        if assignment.world_size == expect_world:
+            break
+        time.sleep(0.2)
+    else:
+        emit(event="error", error="group never reached expected size")
+        sys.exit(2)
+    emit(event="settled", **assignment.to_dict())
+
+    import jax
+    jax.distributed.initialize(**assignment.jax_initialize_args())
+    import numpy as np
+
+    evidence = {
+        "event": "initialized",
+        "rank": assignment.rank,
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "global_devices": len(jax.devices()),
+        "local_devices": len(jax.local_devices()),
+    }
+    try:
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(
+            np.asarray([assignment.rank], np.int32))
+        evidence["collective"] = sorted(
+            int(x) for x in np.asarray(gathered).ravel())
+    except Exception as exc:  # CPU cross-process collectives optional
+        evidence["collective"] = None
+        evidence["collective_error"] = f"{type(exc).__name__}: {exc}"
+    emit(**evidence)
+    jax.distributed.shutdown()
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    main()

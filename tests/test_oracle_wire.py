@@ -1,0 +1,124 @@
+"""Oracle wire client: TNS framing (CONNECT/RESEND/ACCEPT/REFUSE,
+markers), O5LOGON-style auth, statements with :n binds, transactions,
+ORA-coded errors — against the mini Oracle server."""
+
+from dataclasses import dataclass
+
+import pytest
+
+from gofr_tpu.datasource.oracle_wire import (MiniOracleServer, OracleError,
+                                             OracleWire)
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = MiniOracleServer(service_name="FREEPDB1",
+                           users={"app": "tiger"})
+    srv.start()
+    yield srv
+    srv.close()
+
+
+@pytest.fixture()
+def db(server):
+    wire = OracleWire(port=server.port, service_name="FREEPDB1",
+                      username="app", password="tiger")
+    wire.connect()
+    yield wire
+    wire.close()
+
+
+def test_connect_ping_dual(db):
+    db.ping()
+    row = db.query_row("SELECT 1 AS N FROM DUAL")
+    assert row["N"] == "1"
+
+
+def test_ddl_dml_binds_roundtrip(db):
+    db.exec("CREATE TABLE IF NOT EXISTS emp (id INTEGER, name TEXT)")
+    db.exec("DELETE FROM emp")
+    assert db.exec("INSERT INTO emp (id, name) VALUES (:1, :2)",
+                   1, "scott") == 1
+    db.exec("INSERT INTO emp (id, name) VALUES (:1, :2)", 2, "king")
+    rows = db.query("SELECT id, name FROM emp WHERE id > :1 "
+                    "ORDER BY id", 0)
+    assert [(r["ID"], r["NAME"]) for r in rows] == [("1", "scott"),
+                                                    ("2", "king")]
+
+
+def test_select_into_dataclass(db):
+    @dataclass
+    class Emp:
+        id: str
+        name: str
+
+    db.exec("CREATE TABLE IF NOT EXISTS emp2 (id INTEGER, name TEXT)")
+    db.exec("INSERT INTO emp2 (id, name) VALUES (:1, :2)", 7, "adams")
+    got = db.select(Emp, "SELECT id, name FROM emp2 WHERE id = :1", 7)
+    assert got == [Emp(id="7", name="adams")]
+
+
+def test_transaction_commit_and_rollback(db):
+    db.exec("CREATE TABLE IF NOT EXISTS acct (id INTEGER, bal INTEGER)")
+    db.exec("DELETE FROM acct")
+    with db.begin() as tx:
+        tx.exec("INSERT INTO acct (id, bal) VALUES (:1, :2)", 1, 100)
+    assert db.query_row("SELECT COUNT(*) AS C FROM acct")["C"] == "1"
+    with pytest.raises(RuntimeError):
+        with db.begin() as tx:
+            tx.exec("INSERT INTO acct (id, bal) VALUES (:1, :2)", 2, 200)
+            raise RuntimeError("boom")
+    assert db.query_row("SELECT COUNT(*) AS C FROM acct")["C"] == "1"
+
+
+def test_sql_error_is_ora_coded_after_break_marker(db):
+    with pytest.raises(OracleError) as e:
+        db.query("SELECT * FROM no_such_table_anywhere")
+    assert e.value.code == 900          # ORA-00900 invalid SQL statement
+    db.ping()                           # marker/reset left session usable
+
+
+def test_wrong_password_ora_01017(server):
+    bad = OracleWire(port=server.port, username="app", password="WRONG")
+    with pytest.raises(OracleError) as e:
+        bad.connect()
+    assert e.value.code == 1017
+
+
+def test_unknown_service_refused(server):
+    lost = OracleWire(port=server.port, service_name="NOPE",
+                      username="app", password="tiger")
+    with pytest.raises(OracleError) as e:
+        lost.connect()
+    assert "12514" in str(e.value)
+
+
+def test_null_values(db):
+    db.exec("CREATE TABLE IF NOT EXISTS nt (id INTEGER, v TEXT)")
+    db.exec("INSERT INTO nt (id, v) VALUES (:1, :2)", 1, None)
+    row = db.query_row("SELECT v FROM nt WHERE id = :1", 1)
+    assert row["V"] is None
+
+
+def test_health_check(db, server):
+    assert db.health_check()["status"] == "UP"
+    assert OracleWire(port=1, timeout_s=0.5).health_check()["status"] \
+        == "DOWN"
+
+
+def test_survives_byte_dribble(server):
+    """Full TNS stack (CONNECT/RESEND/ACCEPT, auth, DATA frames) over
+    1-byte fragments."""
+    from .test_wire_fragmentation import DribbleProxy
+
+    proxy = DribbleProxy("127.0.0.1", server.port)
+    try:
+        wire = OracleWire(port=proxy.port, username="app",
+                          password="tiger", timeout_s=60)
+        wire.connect()
+        wire.exec("CREATE TABLE IF NOT EXISTS frag (x INTEGER)")
+        wire.exec("INSERT INTO frag (x) VALUES (:1)", 42)
+        assert wire.query_row("SELECT x FROM frag")["X"] == "42"
+        wire.close()
+    finally:
+        proxy.close()
