@@ -192,6 +192,18 @@ class App:
             self.grpc_server = GRPCServer(self.container, port=port,
                                           logger=self.logger)
         self.grpc_server.register(service)
+        # protogen modules carry their protoc-compiled descriptors —
+        # register them so reflection answers symbol lookups for real.
+        # The constant lives in the GENERATED module, which is usually
+        # a base class's module (users subclass <Service>Base in their
+        # own app module), so walk the MRO
+        import sys as _sys
+        for klass in type(service).__mro__:
+            module = _sys.modules.get(klass.__module__)
+            fds = getattr(module, "FILE_DESCRIPTOR_SET", None)
+            if fds:
+                self.grpc_server.register_descriptors(fds)
+                break
 
     def add_ws_service(self, name: str, url: str, *,
                        headers: dict[str, str] | None = None,

@@ -92,6 +92,8 @@ class GRPCServer:
         self._services: list[GRPCService] = []
         self._server: grpc.aio.Server | None = None
         self.bound_port: int = port
+        from .reflection import DescriptorRegistry
+        self._descriptors = DescriptorRegistry()
         container.metrics.new_histogram(
             "app_grpc_server_duration", "gRPC server handle time in seconds",
             buckets=_GRPC_BUCKETS)
@@ -107,6 +109,11 @@ class GRPCServer:
         service.container = self.container
         self._services.append(service)
         self.health.set(service.name, SERVING)
+
+    def register_descriptors(self, fds: bytes) -> None:
+        """Feed a protoc-compiled FileDescriptorSet (protogen's
+        FILE_DESCRIPTOR_SET) to the reflection surface."""
+        self._descriptors.add_serialized_set(fds)
 
     # ------------------------------------------------------ observability
     def _observed(self, service: GRPCService, spec: RPCSpec):
@@ -247,7 +254,8 @@ class GRPCServer:
                 "grpc.reflection.v1alpha.ServerReflection",
                 "grpc.reflection.v1.ServerReflection"]
             self._server.add_generic_rpc_handlers(
-                tuple(reflection_handler(lambda: sorted(names))))
+                tuple(reflection_handler(lambda: sorted(names),
+                                         registry=self._descriptors)))
         self.bound_port = self._server.add_insecure_port(
             f"0.0.0.0:{self.port}")
         if self.bound_port == 0 and self.port != 0:

@@ -14,7 +14,7 @@ reference grpc.go:222-269 injectContainer) plus the decoded request.
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Any, Callable
 
 UNARY = "unary"
@@ -98,6 +98,16 @@ class GRPCService:
         for attr in dir(cls):
             member = getattr(cls, attr)
             spec = getattr(member, "__rpc_spec__", None)
+            if spec is None:
+                # a subclass overriding a decorated base method (the
+                # protogen skeleton pattern) keeps the base's spec but
+                # serves the OVERRIDING implementation
+                for base in cls.__mro__[1:]:
+                    base_spec = getattr(getattr(base, attr, None),
+                                        "__rpc_spec__", None)
+                    if base_spec is not None:
+                        spec = replace(base_spec, fn=member)
+                        break
             if spec is not None:
                 specs.append(spec)
         return specs

@@ -1,0 +1,85 @@
+"""TPU job: saturation through the REAL HTTP stack on the 1B model
+(VERDICT r3 #9): 96 concurrent /chat requests against the app server +
+engine on the chip; reports req/s, p50/p99 TTFT, fairness ratio.
+"""
+
+import json
+import statistics
+import threading
+import time
+
+import jax
+
+assert jax.default_backend() != "cpu", "TPU job ran on CPU"
+
+from gofr_tpu.models.llama import LlamaConfig, llama_init
+from gofr_tpu.serving.engine import EngineConfig, SamplingParams
+from gofr_tpu.serving.glue import llama_engine
+from gofr_tpu.serving.handlers import make_chat_handler
+from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+import sys
+sys.path.insert(0, "tests")
+from apputil import AppRunner  # noqa: E402  (the test harness runner)
+
+config = LlamaConfig.llama3_1b().scaled(max_seq=1024)
+params = llama_init(jax.random.key(0), config)
+jax.block_until_ready(params)
+
+engine = llama_engine(params, config, EngineConfig(
+    max_batch=32, max_seq=config.max_seq, seed=0,
+    prefill_buckets=(64, 128, 256, 512)))
+engine.warmup(prompt_lens=(64,))
+engine.start()
+
+N, GEN = 96, 32
+results, errors = [], []
+lock = threading.Lock()
+
+with AppRunner() as runner:
+    runner.app.post("/chat", make_chat_handler(engine, ByteTokenizer()))
+
+    def one(i):
+        t0 = time.perf_counter()
+        try:
+            status, _, data = runner.request(
+                "POST", "/chat",
+                body={"prompt": "x" * 64, "max_tokens": GEN,
+                      "temperature": 0.0}, timeout=600)
+            body = json.loads(data)
+            with lock:
+                if status == 201:
+                    results.append({
+                        "wall": time.perf_counter() - t0,
+                        "ttft_ms": body["data"]["usage"]["ttft_ms"]})
+                else:
+                    errors.append(f"{status}: {data[:100]}")
+        except Exception as exc:
+            with lock:
+                errors.append(repr(exc))
+
+    t0 = time.time()
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=900)
+    wall = time.time() - t0
+
+engine.stop()
+ttfts = sorted(r["ttft_ms"] for r in results if r["ttft_ms"])
+out = {
+    "job": "http_saturation", "device": jax.devices()[0].device_kind,
+    "n": N, "ok": len(results), "errors": len(errors),
+    "error_sample": errors[:3],
+    "wall_s": round(wall, 2),
+    "req_per_s": round(len(results) / wall, 2),
+    "tok_per_s": round(len(results) * GEN / wall, 1),
+    "p50_ttft_ms": round(statistics.median(ttfts), 1) if ttfts else -1,
+    "p99_ttft_ms": round(ttfts[int(0.99 * (len(ttfts) - 1))], 1)
+    if ttfts else -1,
+    "fairness_max_over_p50": round(ttfts[-1] / max(1e-9,
+                                   statistics.median(ttfts)), 1)
+    if ttfts else -1,
+}
+print("RESULT_JSON " + json.dumps(out))

@@ -282,6 +282,33 @@ def test_grpc_server():
         assert ticks == [2, 1]
 
 
+def test_grpc_protogen_example():
+    """The protogen example: .proto → generated skeleton → served app,
+    called through the generated client."""
+    mod = load_example("grpc-protogen")
+    app = mod.build_app(cfg(GRPC_PORT="0"))
+    with AppRunner(app=app):
+        import grpc
+
+        import order_gofr
+
+        async def flow():
+            async with grpc.aio.insecure_channel(
+                    f"127.0.0.1:{app.grpc_server.bound_port}") as channel:
+                client = order_gofr.OrderDeskClient(channel)
+                ack = await client.Place(order_gofr.Order(
+                    id="o-7", item="tpu", quantity=2))
+                ack = ack.get("data", ack)
+                statuses = []
+                async for item in client.Track(
+                        order_gofr.Order(id="o-7")):
+                    statuses.append(item.get("data", item)["status"])
+                return ack, statuses
+        ack, statuses = asyncio.run(flow())
+        assert ack["status"] == "ACCEPTED"
+        assert statuses == ["ACCEPTED", "PACKED", "SHIPPED"]
+
+
 def test_grpc_client_example():
     """The client example drives the server example end-to-end: HTTP
     in, gRPC out (unary + stream + health)."""

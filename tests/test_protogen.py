@@ -1,0 +1,191 @@
+"""protogen (.proto → service skeleton, the gofr-cli analog): parse,
+generate, import, implement, serve, call with the generated client, and
+reflection answering file_containing_symbol with protoc descriptors."""
+
+import asyncio
+import importlib.util
+import sys
+import textwrap
+
+import pytest
+
+from gofr_tpu.grpc.protogen import generate, parse_proto
+
+PROTO = textwrap.dedent("""\
+    syntax = "proto3";
+
+    package demo.greeter;
+
+    // a message with a few shapes
+    message HelloRequest {
+      string name = 1;
+      int32 times = 2;
+      repeated string tags = 3;
+    }
+
+    message HelloReply {
+      string message = 1;
+      bool ok = 2;
+    }
+
+    service Greeter {
+      rpc SayHello (HelloRequest) returns (HelloReply);
+      rpc StreamHello (HelloRequest) returns (stream HelloReply);
+    }
+""")
+
+
+@pytest.fixture(scope="module")
+def generated(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("protogen")
+    proto = tmp / "greeter.proto"
+    proto.write_text(PROTO)
+    out = tmp / "greeter_gofr.py"
+    out.write_text(generate(proto))
+    spec = importlib.util.spec_from_file_location("greeter_gofr", out)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules["greeter_gofr"] = module
+    spec.loader.exec_module(module)
+    yield module
+    sys.modules.pop("greeter_gofr", None)
+
+
+def test_parse_proto_shapes():
+    pf = parse_proto(PROTO)
+    assert pf.package == "demo.greeter"
+    assert [m.name for m in pf.messages] == ["HelloRequest", "HelloReply"]
+    req = pf.messages[0]
+    assert [(f.name, f.type, f.repeated) for f in req.fields] == [
+        ("name", "string", False), ("times", "int32", False),
+        ("tags", "string", True)]
+    svc = pf.services[0]
+    assert svc.name == "Greeter"
+    assert [(r.name, r.server_stream) for r in svc.rpcs] == [
+        ("SayHello", False), ("StreamHello", True)]
+
+
+def test_generated_module_shape(generated):
+    m = generated
+    assert m.GreeterBase.name == "demo.greeter.Greeter"
+    req = m.HelloRequest(name="x")
+    assert req.times == 0 and req.tags == []
+    assert m.HelloRequest.from_dict({"name": "y", "junk": 1}).name == "y"
+    # skeleton methods are registered rpcs but unimplemented
+    specs = {s.name: s.kind for s in m.GreeterBase.rpc_specs()}
+    assert specs == {"SayHello": "unary", "StreamHello": "server_stream"}
+    # protoc is in the image: descriptors must have been compiled in
+    assert m.FILE_DESCRIPTOR_SET
+
+
+def test_serve_and_call_with_generated_client(generated):
+    """Subclass the skeleton, serve it on the framework's gRPC server,
+    call both RPCs through the generated client."""
+    import grpc
+
+    from gofr_tpu.config.env import DictConfig
+    from gofr_tpu.container.container import Container
+    from gofr_tpu.grpc.server import GRPCServer
+
+    m = generated
+
+    class Greeter(m.GreeterBase):
+        async def SayHello(self, ctx, request):
+            req = m.HelloRequest.from_dict(request)
+            return {"message": f"hello {req.name}", "ok": True}
+
+        async def StreamHello(self, ctx, request):
+            req = m.HelloRequest.from_dict(request)
+            for i in range(max(1, req.times)):
+                yield {"message": f"hello {req.name} #{i}", "ok": True}
+
+    async def scenario():
+        container = Container(DictConfig({
+            "APP_NAME": "protogen-test",
+            "GRPC_ENABLE_REFLECTION": "true"}))
+        server = GRPCServer(container, port=0)
+        server.register(Greeter())
+        server.register_descriptors(m.FILE_DESCRIPTOR_SET)
+        await server.start()
+        try:
+            async with grpc.aio.insecure_channel(
+                    f"127.0.0.1:{server.bound_port}") as channel:
+                client = m.GreeterClient(channel)
+                reply = await client.SayHello(
+                    m.HelloRequest(name="world"))
+                assert reply["data"]["message"] == "hello world" \
+                    if "data" in reply else \
+                    reply["message"] == "hello world"
+                got = []
+                async for item in client.StreamHello(
+                        m.HelloRequest(name="s", times=3)):
+                    got.append(item)
+                texts = [(e.get("data") or e)["message"] if "data" in e
+                         else e["message"] for e in got]
+                assert len(got) == 3 and texts[0] == "hello s #0"
+
+                # reflection: symbol lookup returns real descriptors
+                from gofr_tpu.grpc.health import (_decode_varint,
+                                                  _encode_varint)
+                stub = channel.stream_stream(
+                    "/grpc.reflection.v1.ServerReflection"
+                    "/ServerReflectionInfo",
+                    request_serializer=lambda b: b,
+                    response_deserializer=lambda b: b)
+
+                sym = b"demo.greeter.Greeter"
+                req = (_encode_varint((4 << 3) | 2)
+                       + _encode_varint(len(sym)) + sym)
+
+                async def one():
+                    yield req
+                async for resp in stub(one()):
+                    # field 4 = file_descriptor_response present
+                    pos, found = 0, False
+                    while pos < len(resp):
+                        tag, pos = _decode_varint(resp, pos)
+                        if tag & 7 == 2:
+                            ln, pos = _decode_varint(resp, pos)
+                            if tag >> 3 == 4:
+                                found = True
+                                assert ln > 0
+                            pos += ln
+                        else:
+                            _, pos = _decode_varint(resp, pos)
+                    assert found, "no FileDescriptorResponse"
+                    break
+        finally:
+            await server.shutdown()
+
+    asyncio.run(scenario())
+
+
+def test_descriptor_registry_nested_symbols(tmp_path):
+    """file_containing_symbol resolves nested messages and enums, not
+    just top-level names (grpcurl describe pkg.Outer.Inner)."""
+    import subprocess
+    import shutil
+
+    from gofr_tpu.grpc.reflection import DescriptorRegistry
+
+    proto = tmp_path / "nested.proto"
+    proto.write_text(textwrap.dedent("""\
+        syntax = "proto3";
+        package deep.pkg;
+        message Outer {
+          message Inner { string v = 1; }
+          enum Mode { OFF = 0; ON = 1; }
+          Inner inner = 1;
+        }
+    """))
+    protoc = shutil.which("protoc")
+    assert protoc, "protoc expected in the image"
+    out = tmp_path / "fds.bin"
+    subprocess.run([protoc, f"-I{tmp_path}", str(proto),
+                    f"--descriptor_set_out={out}"], check=True)
+    reg = DescriptorRegistry()
+    reg.add_serialized_set(out.read_bytes())
+    for symbol in ("deep.pkg.Outer", "deep.pkg.Outer.Inner",
+                   "deep.pkg.Outer.Mode"):
+        assert reg.file_containing_symbol(symbol), symbol
+    assert reg.file_containing_symbol("deep.pkg.Nope") is None
+    assert reg.file_by_filename("nested.proto")
