@@ -6,13 +6,27 @@ the XLA references on-chip, and times both. Prints one JSON line.
 """
 
 import json
+import os
+import sys
+
+# jobs run as `python scripts/tpu_queue/<job>.py` — put the repo root
+# (three levels up) on sys.path so gofr_tpu resolves standalone
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-assert jax.default_backend() != "cpu", "TPU job ran on CPU"
+# GOFR_JOB_SMOKE=1: tiny-shape CPU dry run (interpret-mode kernels) so
+# the job's plumbing is proven before it spends the TPU window
+SMOKE = os.environ.get("GOFR_JOB_SMOKE") == "1"
+if SMOKE:
+    # the env var alone does not beat the axon plugin
+    jax.config.update("jax_platforms", "cpu")
+if not SMOKE:
+    assert jax.default_backend() != "cpu", "TPU job ran on CPU"
 out = {"job": "pallas_smoke", "backend": jax.default_backend(),
        "device": jax.devices()[0].device_kind}
 
@@ -20,14 +34,17 @@ out = {"job": "pallas_smoke", "backend": jax.default_backend(),
 from gofr_tpu.ops.attention import xla_attention
 from gofr_tpu.ops.flash_attention import flash_attention
 
-B, S, HQ, HKV, D = 4, 1024, 32, 8, 64
+B, S, HQ, HKV, D = (2, 128, 4, 2, 16) if SMOKE else (4, 1024, 32, 8, 64)
+dtype = jnp.float32 if SMOKE else jnp.bfloat16
 ks = jax.random.split(jax.random.key(0), 3)
-q = jax.random.normal(ks[0], (B, S, HQ, D), jnp.bfloat16)
-k = jax.random.normal(ks[1], (B, S, HKV, D), jnp.bfloat16)
-v = jax.random.normal(ks[2], (B, S, HKV, D), jnp.bfloat16)
-lens = jnp.asarray([S, S // 2, 100, 7], jnp.int32)
+q = jax.random.normal(ks[0], (B, S, HQ, D), dtype)
+k = jax.random.normal(ks[1], (B, S, HKV, D), dtype)
+v = jax.random.normal(ks[2], (B, S, HKV, D), dtype)
+lens = jnp.asarray(([S, 7] if SMOKE else [S, S // 2, 100, 7]),
+                   jnp.int32)
 
-flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, kv_lengths=lens))
+flash = jax.jit(lambda q, k, v: flash_attention(
+    q, k, v, kv_lengths=lens, interpret=SMOKE))
 ref = jax.jit(lambda q, k, v: xla_attention(q, k, v, causal=True,
                                             kv_lengths=lens))
 got = np.asarray(flash(q, k, v), np.float32)
@@ -37,24 +54,25 @@ err = np.abs(got - want).max()
 out["flash_max_abs_err"] = float(err)
 out["flash_ok"] = bool(err < 0.1)
 
+REPS = 1 if SMOKE else 10
 for fn, name in ((flash, "flash_ms"), (ref, "xla_prefill_ms")):
     r = fn(q, k, v)
     jax.block_until_ready(r)
     t0 = time.perf_counter()
-    for _ in range(10):
+    for _ in range(REPS):
         r = fn(q, k, v)
     jax.block_until_ready(r)
-    out[name] = round((time.perf_counter() - t0) / 10 * 1e3, 3)
+    out[name] = round((time.perf_counter() - t0) / REPS * 1e3, 3)
 
 # ---- ragged paged decode attention on-chip
 from gofr_tpu.ops.paged_attention import (paged_decode_attention_pallas,
                                           paged_decode_attention_xla)
 
-NP_, PG, MP = 512, 64, 16
-B2 = 16
-kp = jax.random.normal(ks[0], (NP_, PG, HKV, D), jnp.bfloat16)
-vp = jax.random.normal(ks[1], (NP_, PG, HKV, D), jnp.bfloat16)
-q2 = jax.random.normal(ks[2], (B2, HQ, D), jnp.bfloat16)
+NP_, PG, MP = (16, 16, 4) if SMOKE else (512, 64, 16)
+B2 = 2 if SMOKE else 16
+kp = jax.random.normal(ks[0], (NP_, PG, HKV, D), dtype)
+vp = jax.random.normal(ks[1], (NP_, PG, HKV, D), dtype)
+q2 = jax.random.normal(ks[2], (B2, HQ, D), dtype)
 rng = np.random.default_rng(0)
 tables = np.full((B2, MP), NP_, np.int32)
 lengths = rng.integers(1, MP * PG, B2).astype(np.int32)
@@ -65,7 +83,7 @@ tables = jnp.asarray(tables)
 lengths_j = jnp.asarray(lengths)
 
 pag = jax.jit(lambda q, kp, vp: paged_decode_attention_pallas(
-    q, kp, vp, tables, lengths_j))
+    q, kp, vp, tables, lengths_j, interpret=SMOKE))
 ref2 = jax.jit(lambda q, kp, vp: paged_decode_attention_xla(
     q, kp, vp, tables, lengths_j))
 got2 = np.asarray(pag(q2, kp, vp), np.float32)
@@ -74,13 +92,14 @@ err2 = np.abs(got2 - want2).max()
 out["paged_max_abs_err"] = float(err2)
 out["paged_ok"] = bool(err2 < 0.1)
 
+REPS2 = 1 if SMOKE else 50
 for fn, name in ((pag, "paged_kernel_ms"), (ref2, "paged_gather_ms")):
     r = fn(q2, kp, vp)
     jax.block_until_ready(r)
     t0 = time.perf_counter()
-    for _ in range(50):
+    for _ in range(REPS2):
         r = fn(q2, kp, vp)
     jax.block_until_ready(r)
-    out[name] = round((time.perf_counter() - t0) / 50 * 1e3, 3)
+    out[name] = round((time.perf_counter() - t0) / REPS2 * 1e3, 3)
 
 print("RESULT_JSON " + json.dumps(out))

@@ -52,6 +52,10 @@ def _env_tpu() -> dict:
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     env["GOFR_TELEMETRY"] = "false"
+    # jobs run as `python scripts/tpu_queue/<job>.py`, which puts the
+    # QUEUE dir (not the repo) on sys.path — gofr_tpu must resolve
+    env["PYTHONPATH"] = REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
     return env
 
 
