@@ -36,7 +36,7 @@ DONE = os.path.join(HERE, "tpu_done")
 RESULTS = os.path.join(HERE, "tpu_results")
 STATE = os.path.join(HERE, "tpu_state.jsonl")
 
-PROBE_INTERVAL_S = int(os.environ.get("GOFR_TPU_PROBE_INTERVAL", "240"))
+PROBE_INTERVAL_S = int(os.environ.get("GOFR_TPU_PROBE_INTERVAL", "120"))
 PROBE_TIMEOUT_S = int(os.environ.get("GOFR_TPU_PROBE_TIMEOUT", "180"))
 JOB_TIMEOUT_S = int(os.environ.get("GOFR_TPU_JOB_TIMEOUT", "1800"))
 MAX_RUNTIME_S = int(os.environ.get("GOFR_TPU_WORKER_MAX_S", str(11 * 3600)))
@@ -91,9 +91,15 @@ def _probe() -> dict | None:
     return None
 
 
+_attempts: dict[str, int] = {}
+MAX_ATTEMPTS = 3
+
+
 def _run_job(path: str) -> None:
     name = os.path.basename(path)
-    _log({"event": "job_start", "job": name})
+    _attempts[name] = _attempts.get(name, 0) + 1
+    _log({"event": "job_start", "job": name,
+          "attempt": _attempts[name]})
     t0 = time.time()
     try:
         p = subprocess.run([sys.executable, path], env=_env_tpu(),
@@ -106,13 +112,22 @@ def _run_job(path: str) -> None:
         err = (e.stderr.decode() if isinstance(e.stderr, bytes) else (e.stderr or "")) \
             + f"\n[timeout after {JOB_TIMEOUT_S}s]"
     wall = round(time.time() - t0, 1)
-    result = {"job": name, "ok": rc == 0, "rc": rc, "wall_s": wall,
+    ok = rc == 0
+    result = {"job": name, "ok": ok, "rc": rc, "wall_s": wall,
+              "attempt": _attempts[name],
               "stdout": out[-20000:], "stderr": err[-8000:],
               "ts": round(time.time(), 1)}
     with open(os.path.join(RESULTS, name + ".json"), "w") as f:
         json.dump(result, f, indent=1)
+    if not ok and _attempts[name] < MAX_ATTEMPTS:
+        # most failures here are the tunnel dying mid-job — leave it
+        # queued for the next healthy window (bounded, so a
+        # deterministic crash cannot eat every window)
+        _log({"event": "job_retry_queued", "job": name,
+              "attempt": _attempts[name], "wall_s": wall})
+        return
     shutil.move(path, os.path.join(DONE, name))
-    _log({"event": "job_done", "job": name, "ok": rc == 0, "wall_s": wall})
+    _log({"event": "job_done", "job": name, "ok": ok, "wall_s": wall})
 
 
 def main() -> None:
