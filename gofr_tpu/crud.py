@@ -54,8 +54,11 @@ def scan_entity(cls: type) -> EntitySpec:
 
 
 def _row_to_entity(spec: EntitySpec, row: Any) -> Any:
-    keys = set(row.keys())
-    return spec.cls(**{f: row[f] for f in spec.fields if f in keys})
+    # oracle-family stores report UPPERCASE column names; match the
+    # dataclass fields case-insensitively like OracleWire.select does
+    by_fold = {str(k).lower(): k for k in row.keys()}
+    return spec.cls(**{f: row[by_fold[f.lower()]]
+                       for f in spec.fields if f.lower() in by_fold})
 
 
 def _entity_to_dict(entity: Any) -> dict[str, Any]:

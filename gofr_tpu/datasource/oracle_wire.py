@@ -44,7 +44,7 @@ from contextlib import contextmanager
 from dataclasses import fields as dc_fields, is_dataclass
 from typing import Any, Iterator
 
-from . import ProviderMixin
+from . import Instrumented
 
 # ------------------------------------------------------------- TNS layer
 
@@ -173,8 +173,12 @@ class OracleRow(dict):
     __getattr__ = dict.get
 
 
-class OracleWire(ProviderMixin):
+class OracleWire(Instrumented):
     """Reference Connection/Txn surface over the TNS transport."""
+
+    metric = "app_oracle_stats"
+    log_tag = "ORACLE"
+    dialect = "oracle"  # query-builder/auto-CRUD placeholder selection
 
     def __init__(self, *, host: str = "127.0.0.1", port: int = 1521,
                  service_name: str = "FREEPDB1", username: str = "",
@@ -297,30 +301,22 @@ class OracleWire(ProviderMixin):
             raise OracleError("not connected", 3114)
         return self._sock, self._stream
 
-    def _observe(self, op: str, query: str, start: float) -> None:
-        micros = int((time.perf_counter() - start) * 1e6)
-        if self.logger is not None:
-            self.logger.debug(f"ORACLE {micros:8d}µs {query}")
-        if self.metrics is not None:
-            self.metrics.record_histogram("app_oracle_stats", micros / 1e6,
-                                          type=op)
-
     def _roundtrip(self, op: str, query: str,
                    args: tuple) -> list[tuple[str, bytes]]:
-        start = time.perf_counter()
-        with self._lock:
-            sock, stream = self._require()
-            pairs = [("FUNCTION", b"EXEC"), ("SQL", query.encode())]
-            for arg in args:
-                if arg is None:
-                    pairs.append(("BIND_NULL", b""))
-                else:
-                    pairs.append(("BIND", str(arg).encode()))
-            send_data(sock, _wire_fields(pairs))
-            try:
+        def go():
+            with self._lock:
+                sock, stream = self._require()
+                pairs = [("FUNCTION", b"EXEC"), ("SQL", query.encode())]
+                for arg in args:
+                    if arg is None:
+                        pairs.append(("BIND_NULL", b""))
+                    else:
+                        pairs.append(("BIND", str(arg).encode()))
+                send_data(sock, _wire_fields(pairs))
                 return self._read_reply(stream, sock)
-            finally:
-                self._observe(op, query, start)
+        # Instrumented._observed: QueryLog line + lazily-registered
+        # app_oracle_stats histogram, same as every other store
+        return self._observed(op.upper(), query, go)
 
     def ph(self, n: int) -> str:
         return f":{n}"                        # Oracle bind placeholder

@@ -40,6 +40,7 @@ DIALECT_MYSQL = "mysql"
 DIALECT_POSTGRES = "postgres"
 DIALECT_COCKROACH = "cockroachdb"
 DIALECT_SUPABASE = "supabase"
+DIALECT_ORACLE = "oracle"  # network wire client only (oracle_wire)
 
 _DIALECTS = (DIALECT_SQLITE, DIALECT_MYSQL, DIALECT_POSTGRES,
              DIALECT_COCKROACH, DIALECT_SUPABASE)
@@ -69,6 +70,8 @@ def placeholder(dialect: str, n: int) -> str:
     (reference sql/query_builder.go)."""
     if dialect in _DOLLAR_PLACEHOLDER:
         return f"${n}"
+    if dialect == DIALECT_ORACLE:
+        return f":{n}"
     return "?"
 
 
@@ -273,11 +276,20 @@ def new_sql(config: Any, logger: Any = None, metrics: Any = None,
     if not dialect:
         return None
     host = config.get("DB_HOST")
+    if dialect == DIALECT_ORACLE and not host:
+        # the embedded engine has no oracle mode — surface the actual
+        # misconfiguration, not an "unsupported dialect" red herring
+        if logger is not None:
+            logger.error("SQL disabled: DB_DIALECT=oracle requires "
+                         "DB_HOST (the TNS wire client)")
+        return None
     if host and (dialect in _DOLLAR_PLACEHOLDER
-                 or dialect == DIALECT_MYSQL):
+                 or dialect in (DIALECT_MYSQL, DIALECT_ORACLE)):
         # a network server: dial it over the real wire protocol
-        # (reference sql.go:74 does this via lib/pq / go-sql-driver)
-        default_port = "3306" if dialect == DIALECT_MYSQL else "5432"
+        # (reference sql.go:74 does this via lib/pq / go-sql-driver;
+        # oracle rides its own wire module, TNS + O5LOGON)
+        default_port = {DIALECT_MYSQL: "3306",
+                        DIALECT_ORACLE: "1521"}.get(dialect, "5432")
         try:
             port = int(config.get_or_default("DB_PORT",
                                              default_port).strip())
@@ -286,14 +298,21 @@ def new_sql(config: Any, logger: Any = None, metrics: Any = None,
                 logger.error("SQL disabled: DB_PORT is not an integer")
             return None
         user = config.get_or_default(
-            "DB_USER", "root" if dialect == DIALECT_MYSQL else "postgres")
+            "DB_USER", {DIALECT_MYSQL: "root",
+                        DIALECT_ORACLE: "system"}.get(dialect, "postgres"))
         password = config.get_or_default("DB_PASSWORD", "")
         name = config.get_or_default(
-            "DB_NAME", "" if dialect == DIALECT_MYSQL else "postgres")
+            "DB_NAME", {DIALECT_MYSQL: "",
+                        DIALECT_ORACLE: "FREEPDB1"}.get(dialect,
+                                                        "postgres"))
         if dialect == DIALECT_MYSQL:
             from .mysql_wire import MySQLWire
             db: Any = MySQLWire(host=host, port=port, user=user,
                                 password=password, database=name)
+        elif dialect == DIALECT_ORACLE:
+            from .oracle_wire import OracleWire
+            db = OracleWire(host=host, port=port, username=user,
+                            password=password, service_name=name)
         else:
             from .postgres_wire import PostgresWire
             db = PostgresWire(host=host, port=port, user=user,

@@ -1,0 +1,104 @@
+"""TPU job: long-context serving — prompts far beyond the widest
+prefill bucket walk the chunked-prefill path against the growing
+cache; measures prefill throughput, TTFT, and decode rate at 2k-token
+contexts for the slot layout and the paged layout (ragged kernel).
+One JSON line.
+"""
+
+import json
+import os
+import sys
+
+# jobs run as `python scripts/tpu_queue/<job>.py` — put the repo root
+# (three levels up) on sys.path so gofr_tpu resolves standalone
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+import statistics
+import time
+
+import jax
+
+SMOKE = os.environ.get("GOFR_JOB_SMOKE") == "1"
+if SMOKE:
+    # the env var alone does not beat the axon plugin
+    jax.config.update("jax_platforms", "cpu")
+if not SMOKE:
+    assert jax.default_backend() != "cpu", "TPU job ran on CPU"
+
+from gofr_tpu.models.llama import LlamaConfig, llama_init
+from gofr_tpu.serving.engine import EngineConfig, SamplingParams
+from gofr_tpu.serving.glue import llama_engine
+
+if SMOKE:
+    config = LlamaConfig.tiny().scaled(max_seq=256)
+    PROMPT_LEN, GEN, N_REQ, MB = 96, 8, 4, 2
+    BUCKETS = (32,)
+else:
+    config = LlamaConfig.llama3_1b().scaled(max_seq=4096)
+    PROMPT_LEN, GEN, N_REQ, MB = 2048, 32, 8, 8
+    BUCKETS = (256, 512)
+
+params = llama_init(jax.random.key(0), config)
+jax.block_until_ready(params)
+points = []
+
+
+def run_point(layout, paged_attention="auto"):
+    if SMOKE and paged_attention == "kernel":
+        paged_attention = "interpret"
+    eng_cfg = EngineConfig(
+        max_batch=MB, max_seq=config.max_seq, prefill_buckets=BUCKETS,
+        seed=0, kv_layout=layout, page_size=16 if SMOKE else 64,
+        paged_attention=paged_attention,
+        prefill_chunks_per_pass=2)
+    engine = llama_engine(params, config, eng_cfg)
+    engine.warmup(prompt_lens=(max(BUCKETS),), chunked=True)
+    engine.start()
+    sp = SamplingParams(temperature=0.0, max_new_tokens=GEN)
+
+    def prompt(i):
+        # distinct LEADING token per request: the prefix cache cannot
+        # hit, so the paged point measures the chunk walk itself
+        return [201 + i] + [1 + (j % 200) for j in range(PROMPT_LEN - 1)]
+    rinse = engine.submit(prompt(98), sp)
+    while rinse.finished_at is None and rinse.error is None:
+        time.sleep(0.005)
+    settle = time.time() + 5
+    while engine._pending and time.time() < settle:
+        time.sleep(0.01)
+    engine.stats = {k: 0 if isinstance(v, int) else 0.0
+                    for k, v in engine.stats.items()}
+    t0 = time.time()
+    reqs = [engine.submit(prompt(i), sp) for i in range(N_REQ)]
+    while any(r.finished_at is None and r.error is None for r in reqs):
+        time.sleep(0.005)
+    wall = time.time() - t0
+    stats = dict(engine.stats)
+    engine.stop()
+    ok = [r for r in reqs if r.error is None]
+    ttfts = sorted(r.ttft_ms for r in ok if r.ttft_ms is not None)
+    prefill_tokens = len(ok) * PROMPT_LEN
+    point = {
+        "layout": layout, "paged_attention": paged_attention,
+        "prompt_len": PROMPT_LEN, "ok": len(ok),
+        "wall_s": round(wall, 2),
+        "prefill_tok_per_s": round(
+            prefill_tokens / stats["prefill_s"], 1)
+        if stats["prefill_s"] > 0 else None,
+        "prefill_calls": stats["prefill_calls"],
+        "p50_ttft_ms": round(statistics.median(ttfts), 1) if ttfts else -1,
+        "gen_tok_per_s": round(
+            sum(len(r.generated) for r in ok) / wall, 1),
+        "phases": {k: (round(v, 2) if isinstance(v, float) else v)
+                   for k, v in stats.items()},
+    }
+    points.append(point)
+    print("POINT " + json.dumps(point), flush=True)
+
+
+run_point("slot")
+run_point("paged", paged_attention="kernel")
+
+print("RESULT_JSON " + json.dumps({
+    "job": "long_context", "device": jax.devices()[0].device_kind,
+    "points": points}))

@@ -122,3 +122,68 @@ def test_survives_byte_dribble(server):
         wire.close()
     finally:
         proxy.close()
+
+
+def test_env_driven_selection(server):
+    """DB_DIALECT=oracle + DB_HOST dials the TNS wire client through
+    the same env path postgres/mysql use (reference sql.go:74)."""
+    from gofr_tpu.config.env import DictConfig
+    from gofr_tpu.datasource.sql import new_sql
+
+    db = new_sql(DictConfig({
+        "DB_DIALECT": "oracle", "DB_HOST": "127.0.0.1",
+        "DB_PORT": str(server.port), "DB_NAME": "FREEPDB1",
+        "DB_USER": "app", "DB_PASSWORD": "tiger"}))
+    assert isinstance(db, OracleWire)
+    assert db.query_row("SELECT 1 AS ONE FROM DUAL")["ONE"] == "1"
+    db.close()
+
+
+def test_env_selection_degrades_gracefully():
+    """Misconfiguration degrades (None + log), never crashes boot."""
+    from gofr_tpu.config.env import DictConfig
+    from gofr_tpu.datasource.sql import new_sql
+
+    # oracle without DB_HOST: explicit message, not "unsupported dialect"
+    assert new_sql(DictConfig({"DB_DIALECT": "oracle"})) is None
+    # malformed port: degrade like the postgres/mysql path
+    assert new_sql(DictConfig({"DB_DIALECT": "oracle",
+                               "DB_HOST": "127.0.0.1",
+                               "DB_PORT": "1521x"})) is None
+
+
+def test_auto_crud_over_oracle(server):
+    """add_rest_handlers works with the Oracle wire client as the
+    container's sql slot: :n placeholders, uppercase column mapping."""
+    import json as _json
+
+    from gofr_tpu.config.env import DictConfig
+    from tests.apputil import AppRunner
+
+    cfg = {"APP_NAME": "crud-ora", "HTTP_PORT": "0", "METRICS_PORT": "0",
+           "GOFR_TELEMETRY": "false", "DB_DIALECT": "oracle",
+           "DB_HOST": "127.0.0.1", "DB_PORT": str(server.port),
+           "DB_NAME": "FREEPDB1", "DB_USER": "app",
+           "DB_PASSWORD": "tiger"}
+
+    from dataclasses import dataclass
+
+    @dataclass
+    class Book:
+        id: int
+        title: str
+
+    with AppRunner(config=cfg) as runner:
+        runner.app.container.sql.exec(
+            "CREATE TABLE IF NOT EXISTS book (id INTEGER, title TEXT)")
+        runner.app.container.sql.exec("DELETE FROM book")
+        from gofr_tpu.crud import add_rest_handlers
+        add_rest_handlers(runner.app, Book)
+        status, _, data = runner.request(
+            "POST", "/book", body={"id": 1, "title": "TNS"})
+        assert status == 201, data
+        status, _, data = runner.request("GET", "/book")
+        assert status == 200
+        rows = _json.loads(data)["data"]
+        assert rows == [{"id": "1", "title": "TNS"}] or \
+            rows == [{"id": 1, "title": "TNS"}], rows
