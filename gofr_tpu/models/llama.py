@@ -28,6 +28,7 @@ import jax.numpy as jnp
 
 from ..ops.attention import attention, decode_attention
 from ..ops.norms import rms_norm
+from ..ops.quant import qgather, qmatmul, qmatmul_t
 from ..ops.rope import apply_rope, rope_frequencies
 
 
@@ -124,35 +125,38 @@ def param_count(params: dict) -> int:
 
 def _attn_block(x, lp, c: LlamaConfig, inv_freq, positions, kv_lengths,
                 implementation):
-    """Self-attention over a full (prefill) block. Returns (out, k, v)."""
+    """Self-attention over a full (prefill) block. Returns (out, k, v).
+    Matrices route through ``qmatmul``: int8-quantized weights (see
+    :mod:`..ops.quant`) dequantize inside the matmul read."""
     b, s, _ = x.shape
     hd = c.head_dim
     h = rms_norm(x, lp["attn_norm"], c.norm_eps)
-    q = (h @ lp["wq"]).reshape(b, s, c.n_heads, hd)
-    k = (h @ lp["wk"]).reshape(b, s, c.n_kv_heads, hd)
-    v = (h @ lp["wv"]).reshape(b, s, c.n_kv_heads, hd)
+    q = qmatmul(h, lp["wq"]).reshape(b, s, c.n_heads, hd)
+    k = qmatmul(h, lp["wk"]).reshape(b, s, c.n_kv_heads, hd)
+    v = qmatmul(h, lp["wv"]).reshape(b, s, c.n_kv_heads, hd)
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
     out = attention(q, k, v, causal=True, kv_lengths=kv_lengths,
                     implementation=implementation)
-    out = out.reshape(b, s, c.n_heads * hd) @ lp["wo"]
+    out = qmatmul(out.reshape(b, s, c.n_heads * hd), lp["wo"])
     return out, k, v
 
 
 def _mlp_block(x, lp, c: LlamaConfig):
     h = rms_norm(x, lp["ffn_norm"], c.norm_eps)
-    return (jax.nn.silu((h @ lp["w1"]).astype(jnp.float32))
-            * (h @ lp["w3"]).astype(jnp.float32)).astype(x.dtype) @ lp["w2"]
+    gate = jax.nn.silu(qmatmul(h, lp["w1"]).astype(jnp.float32))
+    return qmatmul((gate * qmatmul(h, lp["w3"]).astype(jnp.float32))
+                   .astype(x.dtype), lp["w2"])
 
 
 def _logits(params, c: LlamaConfig, x):
-    # LM head runs in the weights' dtype (bf16 in serving) with f32
-    # accumulation: full-rate MXU issue and half the HBM traffic of an
-    # f32 upcast, while the logits still come out f32 for sampling.
+    # LM head runs in the weights' dtype (bf16 in serving; int8 when
+    # quantized — half the HBM traffic again) with f32 accumulation:
+    # the logits come out f32 for sampling either way.
     x = rms_norm(x, params["final_norm"], c.norm_eps)
-    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
-    return jnp.matmul(x.astype(head.dtype), head,
-                      preferred_element_type=jnp.float32)
+    if c.tie_embeddings:
+        return qmatmul_t(x, params["embed"], out_dtype=jnp.float32)
+    return qmatmul(x, params["lm_head"], out_dtype=jnp.float32)
 
 
 def _backbone(params: dict, tokens: jnp.ndarray, c: LlamaConfig,
@@ -163,7 +167,7 @@ def _backbone(params: dict, tokens: jnp.ndarray, c: LlamaConfig,
     b, s = tokens.shape
     inv_freq = rope_frequencies(c.head_dim, c.rope_theta, c.rope_scaling)
     positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
-    x = params["embed"][tokens]
+    x = qgather(params["embed"], tokens, c.dtype)
     if constrain is not None:
         x = constrain(x)
 
@@ -233,21 +237,21 @@ def llama_decode_step(params: dict, tokens: jnp.ndarray,
     hd = c.head_dim
     inv_freq = rope_frequencies(c.head_dim, c.rope_theta, c.rope_scaling)
     positions = lengths[:, None]  # [B, 1] — absolute position of new token
-    x = params["embed"][tokens][:, None, :]  # [B, 1, D]
+    x = qgather(params["embed"], tokens, c.dtype)[:, None, :]  # [B, 1, D]
     batch_idx = jnp.arange(b)
 
     def layer_fn(x, scanned):
         lp, kc, vc = scanned
         h = rms_norm(x, lp["attn_norm"], c.norm_eps)
-        q = (h @ lp["wq"]).reshape(b, 1, c.n_heads, hd)
-        k = (h @ lp["wk"]).reshape(b, 1, c.n_kv_heads, hd)
-        v = (h @ lp["wv"]).reshape(b, 1, c.n_kv_heads, hd)
+        q = qmatmul(h, lp["wq"]).reshape(b, 1, c.n_heads, hd)
+        k = qmatmul(h, lp["wk"]).reshape(b, 1, c.n_kv_heads, hd)
+        v = qmatmul(h, lp["wv"]).reshape(b, 1, c.n_kv_heads, hd)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         kc = kc.at[batch_idx, lengths].set(k[:, 0])
         vc = vc.at[batch_idx, lengths].set(v[:, 0])
         out = decode_attention(q, kc, vc, lengths + 1)
-        x = x + (out.reshape(b, 1, c.n_heads * hd) @ lp["wo"])
+        x = x + qmatmul(out.reshape(b, 1, c.n_heads * hd), lp["wo"])
         x = x + _mlp_block(x, lp, c)
         return x, (kc, vc)
 
@@ -282,7 +286,7 @@ def llama_decode_step_paged(params: dict, tokens: jnp.ndarray,
     n_pages = k_pool.shape[1]
     inv_freq = rope_frequencies(c.head_dim, c.rope_theta, c.rope_scaling)
     positions = lengths[:, None]
-    x = params["embed"][tokens][:, None, :]  # [B, 1, D]
+    x = qgather(params["embed"], tokens, c.dtype)[:, None, :]  # [B, 1, D]
     # the new row's page id and in-page offset via the table; rows at
     # or past the allocation see the OOB id and drop on scatter
     pids = jnp.take_along_axis(
@@ -294,16 +298,16 @@ def llama_decode_step_paged(params: dict, tokens: jnp.ndarray,
     def layer_fn(x, scanned):
         lp, kp, vp = scanned          # [Np, pg, Hkv, hd]
         h = rms_norm(x, lp["attn_norm"], c.norm_eps)
-        q = (h @ lp["wq"]).reshape(b, 1, c.n_heads, hd)
-        k = (h @ lp["wk"]).reshape(b, 1, c.n_kv_heads, hd)
-        v = (h @ lp["wv"]).reshape(b, 1, c.n_kv_heads, hd)
+        q = qmatmul(h, lp["wq"]).reshape(b, 1, c.n_heads, hd)
+        k = qmatmul(h, lp["wk"]).reshape(b, 1, c.n_kv_heads, hd)
+        v = qmatmul(h, lp["wv"]).reshape(b, 1, c.n_kv_heads, hd)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         kp = kp.at[pids, offs].set(k[:, 0].astype(kp.dtype), mode="drop")
         vp = vp.at[pids, offs].set(v[:, 0].astype(vp.dtype), mode="drop")
         out = paged_decode_attention(q[:, 0], kp, vp, tables, lengths + 1,
                                      implementation=implementation)
-        x = x + (out.reshape(b, 1, c.n_heads * hd) @ lp["wo"])
+        x = x + qmatmul(out.reshape(b, 1, c.n_heads * hd), lp["wo"])
         x = x + _mlp_block(x, lp, c)
         return x, (kp, vp)
 
@@ -344,14 +348,14 @@ def llama_prefill_chunk(params: dict, tokens: jnp.ndarray,
     # must never overwrite live cache
     write_pos = jnp.where(valid, positions, smax)
     batch_idx = jnp.arange(b)
-    x = params["embed"][tokens]
+    x = qgather(params["embed"], tokens, c.dtype)
 
     def layer_fn(x, scanned):
         lp, kc, vc = scanned
         h = rms_norm(x, lp["attn_norm"], c.norm_eps)
-        q = (h @ lp["wq"]).reshape(b, s, c.n_heads, hd)
-        k = (h @ lp["wk"]).reshape(b, s, c.n_kv_heads, hd)
-        v = (h @ lp["wv"]).reshape(b, s, c.n_kv_heads, hd)
+        q = qmatmul(h, lp["wq"]).reshape(b, s, c.n_heads, hd)
+        k = qmatmul(h, lp["wk"]).reshape(b, s, c.n_kv_heads, hd)
+        v = qmatmul(h, lp["wv"]).reshape(b, s, c.n_kv_heads, hd)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         kc = kc.at[batch_idx[:, None], write_pos].set(
@@ -365,7 +369,7 @@ def llama_prefill_chunk(params: dict, tokens: jnp.ndarray,
         # picks it up here.
         out = attention(q, kc, vc, causal=True, q_offset=offsets,
                         implementation=implementation)
-        x = x + (out.reshape(b, s, c.n_heads * hd) @ lp["wo"])
+        x = x + qmatmul(out.reshape(b, s, c.n_heads * hd), lp["wo"])
         x = x + _mlp_block(x, lp, c)
         return x, (kc, vc)
 

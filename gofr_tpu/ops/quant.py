@@ -1,0 +1,107 @@
+"""Weight-only int8 quantization — the HBM-bandwidth lever.
+
+Decode is memory-bound: every generated token streams every parameter
+out of HBM once per batch. Storing weights as int8 with per-channel
+bf16 scales halves that traffic, which on a memory-bound roofline is
+up to a 2x decode-throughput ceiling — while matmuls still run in the
+activation dtype on the MXU (weight-only: no activation quantization,
+no accuracy cliff).
+
+Representation: a quantized matrix is the dict ``{"q": int8 array,
+"s": f32 scales}`` — a plain pytree node, so optimizers/checkpoints/
+jit see ordinary leaves. Scales are per-output-channel (max-abs /
+127 over the contraction axis), the standard symmetric scheme;
+``x @ q * s`` applies the scale AFTER the matmul, so XLA reads int8
+from HBM and fuses the upcast into the matmul's operand load. Scales
+store as f32 (bandwidth noise — one scalar per output channel): the
+backbone dequant rounds them to the activation dtype anyway, but the
+f32 LM-head path keeps the full precision where logits are computed.
+
+``quantize_llama_int8`` rewrites a Llama parameter tree in place-shape:
+the seven per-layer matrices and the embedding (per-row scales — it
+serves both the input gather and, tied, the LM head). Norm gains stay
+in full precision (tiny, and sensitive).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+
+def quantize_int8(w: jnp.ndarray, *, axis: int = 0) -> dict:
+    """Symmetric per-channel int8: ``axis`` is the REDUCED axis (the
+    contraction axis of the later matmul), so scales are per output
+    channel. w [.., in, out] with axis=-2 -> s [.., 1, out]."""
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis,
+                   keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
+                 -127, 127).astype(jnp.int8)
+    # f32 scales (see module docstring for the dtype rationale)
+    return {"q": q, "s": scale}
+
+
+def is_quantized(w: Any) -> bool:
+    return isinstance(w, dict) and "q" in w and "s" in w
+
+
+def qmatmul(x: jnp.ndarray, w: Any, *,
+            out_dtype: Any = None) -> jnp.ndarray:
+    """x @ w for plain or quantized ``w`` (scale applied post-matmul)."""
+    if not is_quantized(w):
+        return jnp.matmul(x.astype(w.dtype), w,
+                          preferred_element_type=out_dtype or x.dtype)
+    y = jnp.matmul(x, w["q"].astype(x.dtype),
+                   preferred_element_type=out_dtype or x.dtype)
+    return y * w["s"].astype(y.dtype)
+
+
+def qgather(w: Any, idx: jnp.ndarray, dtype: Any) -> jnp.ndarray:
+    """Embedding-table row gather for plain or quantized tables.
+    Quantized tables carry per-row scales [V, 1]."""
+    if not is_quantized(w):
+        return w[idx]
+    return (w["q"][idx].astype(dtype) * w["s"][idx].astype(dtype))
+
+
+def qmatmul_t(x: jnp.ndarray, w: Any, *, out_dtype: Any = None) -> jnp.ndarray:
+    """x @ w.T for plain or quantized ``w`` — the tied-embedding LM
+    head path: the table's per-row scales [V, 1] become the head's
+    per-output-channel scales."""
+    if not is_quantized(w):
+        return jnp.matmul(x.astype(w.dtype), w.T,
+                          preferred_element_type=out_dtype or x.dtype)
+    y = jnp.matmul(x, w["q"].T.astype(x.dtype),
+                   preferred_element_type=out_dtype or x.dtype)
+    return y * w["s"].reshape(-1).astype(y.dtype)
+
+
+def quantized_bytes(tree: Any) -> int:
+    """Parameter bytes as stored (int8 leaves count 1 byte + scales)."""
+    import jax
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        total += leaf.size * leaf.dtype.itemsize
+    return total
+
+
+def quantize_llama_int8(params: dict) -> dict:
+    """Quantize a Llama tree: per-layer matrices ([L, in, out] — reduce
+    the ``in`` axis) + embedding (per-row) + untied lm_head. Norm gains
+    pass through untouched."""
+    out: dict = {"final_norm": params["final_norm"]}
+    layers = params["layers"]
+    qlayers: dict = {}
+    for name, w in layers.items():
+        if name.endswith("_norm"):
+            qlayers[name] = w
+        else:  # [L, in, out]: reduce axis 1 -> scales [L, 1, out]
+            qlayers[name] = quantize_int8(w, axis=1)
+    out["layers"] = qlayers
+    # embed [V, D]: per-row scales serve the gather AND the tied head
+    out["embed"] = quantize_int8(params["embed"], axis=1)
+    if "lm_head" in params:  # [D, V]: reduce axis 0
+        out["lm_head"] = quantize_int8(params["lm_head"], axis=0)
+    return out

@@ -38,9 +38,24 @@ def llama_engine(params: Any, model_config: LlamaConfig,
                  engine_config: EngineConfig | None = None, *,
                  mesh: Any = None,
                  metrics: Any = None, logger: Any = None,
-                 implementation: str = "auto") -> Engine:
+                 implementation: str = "auto",
+                 quantize: str | None = None) -> Engine:
     engine_config = engine_config or EngineConfig()
     c = model_config
+    if quantize is not None:
+        if quantize != "int8":
+            raise ValueError(f"quantize must be None or 'int8', "
+                             f"got {quantize!r}")
+        if mesh is not None:
+            raise ValueError(
+                "quantize + mesh sharding is not supported yet: the "
+                "sharding specs do not descend into quantized {'q','s'} "
+                "leaves — serve quantized single-chip, or sharded bf16")
+        # weight-only int8: halves HBM param streaming in the
+        # memory-bound decode (ops/quant.py); the model functions
+        # detect quantized leaves per-matrix
+        from ..ops.quant import quantize_llama_int8
+        params = quantize_llama_int8(params)
 
     constrain_kv = None
     if mesh is not None:

@@ -58,7 +58,8 @@ points = []
 
 
 def run_point(max_batch, k_steps, layout, n_requests=None,
-              prompt_len=64, gen_len=64, paged_attention="auto"):
+              prompt_len=64, gen_len=64, paged_attention="auto",
+              quantize=None):
     if SMOKE:
         max_batch = min(max_batch, 4)
         prompt_len, gen_len = 16, 8
@@ -70,7 +71,7 @@ def run_point(max_batch, k_steps, layout, n_requests=None,
         prefill_buckets=(16, 64) if SMOKE else (64, 128, 256, 512),
         seed=0, decode_steps_per_pass=k_steps, kv_layout=layout,
         page_size=16 if SMOKE else 64, paged_attention=paged_attention)
-    engine = llama_engine(params, config, eng_cfg)
+    engine = llama_engine(params, config, eng_cfg, quantize=quantize)
     sp = SamplingParams(temperature=0.0, max_new_tokens=gen_len)
     prompt = list(range(1, prompt_len + 1))
     engine.warmup(prompt_lens=(prompt_len,))
@@ -101,10 +102,13 @@ def run_point(max_batch, k_steps, layout, n_requests=None,
     decode_s = stats["decode_s"]
     decode_toks = toks - len(ok)
     # roofline: in pure decode the pass streams all params once per
-    # K-step x batch tokens — the bound this point is judged against
-    roof_toks = (hbm * 1e9) / (param_bytes / max_batch) if hbm else None
+    # K-step x batch tokens — the bound this point is judged against.
+    # Weight-only int8 halves the streamed bytes, doubling the bound.
+    point_bytes = param_bytes / 2 if quantize == "int8" else param_bytes
+    roof_toks = (hbm * 1e9) / (point_bytes / max_batch) if hbm else None
     point = {
         "layout": layout, "paged_attention": paged_attention,
+        "quantize": quantize,
         "max_batch": max_batch, "k": k_steps,
         "n_requests": n_requests, "ok": len(ok), "wall_s": round(wall, 2),
         "tok_per_s": round(toks / wall, 1),
@@ -137,6 +141,9 @@ for k in (16, 32):
 # paged: gather/scatter view path vs the native ragged kernel path
 run_point(32, 8, "paged", paged_attention="view")
 run_point(32, 8, "paged", paged_attention="kernel")
+# weight-only int8: half the HBM param traffic — the decode-roofline
+# lever (ops/quant.py)
+run_point(32, 8, "slot", quantize="int8")
 
 print("RESULT_JSON " + json.dumps({
     "job": "engine_sweep", "device": DEV, "n_params": n_params,
