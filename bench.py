@@ -98,10 +98,12 @@ print(f"# init {model_config.n_layers}L/{model_config.dim}d "
       f"({n_params/1e9:.2f}B params) in {time.time()-t0:.1f}s on {backend}",
       file=sys.stderr)
 
+quant = os.environ.get("GOFR_BENCH_QUANT") or None
 engine = llama_engine(
     params, model_config,
     EngineConfig(max_batch=max_batch, max_seq=model_config.max_seq,
-                 prefill_buckets=(64, 128, 256, 512), seed=0))
+                 prefill_buckets=(64, 128, 256, 512), seed=0),
+    quantize=quant)
 
 sp = SamplingParams(temperature=0.0, max_new_tokens=gen_len)
 prompt = list(range(1, prompt_len + 1))
@@ -148,8 +150,10 @@ hbm = next((v for k, v in sorted(HBM_GBS.items(),
 flops = 2.0 * n_params * ((total_tokens - len(ok)) + len(ok) * prompt_len)
 mfu = round(flops / (wall * peak), 4) if peak else None
 # decode roofline: HBM-bound — every decode pass streams all params
-# (bf16) once for up to max_batch tokens
-roof = (hbm * 1e9) / (2.0 * n_params / max_batch) if hbm else None
+# once for up to max_batch tokens (bf16 = 2 B/param; int8 halves it)
+bytes_per_param = 1.0 if quant == "int8" else 2.0
+roof = (hbm * 1e9) / (bytes_per_param * n_params / max_batch) \
+    if hbm else None
 # decode_s counts in-flight spans (pipelined passes overlap prefill/
 # host work), so the residual is clamped: it is true dead time only
 host_s = round(max(0.0, wall - stats["prefill_s"] - stats["decode_s"]), 2)
@@ -175,6 +179,7 @@ print("BENCH_JSON " + json.dumps({
                "decode_passes": stats["decode_passes"],
                "host_s": host_s},
     "platform": backend,
+    "quantize": quant,
     "n_requests": n_requests,
 }))
 """
@@ -239,8 +244,14 @@ def _cached_tpu_result():
                     continue
                 payload = json.loads(line)
                 age_ok = _time.time() - rec.get("ts", 0) <= max_age_s
+                # the cached run must match THIS run's quantization
+                # mode — a bf16 payload must never stand in for an
+                # int8 headline (or vice versa)
+                quant_ok = payload.get("quantize") == (
+                    os.environ.get("GOFR_BENCH_QUANT") or None)
                 if payload.get("platform") == "tpu" \
-                        and payload.get("value", 0) > 0 and age_ok:
+                        and payload.get("value", 0) > 0 \
+                        and age_ok and quant_ok:
                     if best is None or rec.get("ts", 0) > best[1]:
                         best = (payload, rec.get("ts", 0), name)
                 break

@@ -1,0 +1,10 @@
+"""TPU job: the standard bench with weight-only int8 — the quantized
+headline number next to 01's bf16 baseline."""
+import os
+import runpy
+
+os.environ["GOFR_BENCH_PLATFORM"] = "tpu"
+os.environ["GOFR_BENCH_QUANT"] = "int8"
+runpy.run_path(os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "bench.py"), run_name="__main__")
