@@ -7,7 +7,12 @@ the control-plane leader over HTTP, and depending on GOFR_MODE either
   ``jax.distributed.initialize(**assignment.jax_initialize_args())``
   (the SURVEY §4 hand-off this harness exists to prove), verifies the
   global process/device view, attempts one cross-process collective,
-  prints evidence as JSON lines, and exits; or
+  prints evidence as JSON lines, and exits;
+- ``jax_tp``: everything ``jax`` does, then runs a tensor-parallel
+  tiny-llama greedy decode as ONE SPMD program spanning the whole
+  2-process mesh (collectives cross the OS-process boundary) and
+  emits the tokens — the scenario constants (``TP_PROMPT`` etc.) are
+  shared with the test's single-device reference; or
 - ``plain``: joins and heartbeats forever (the test kills it to drive
   eviction), printing every assignment change.
 
@@ -25,13 +30,20 @@ def emit(**kw):
     print("EV " + json.dumps(kw), flush=True)
 
 
+#: the jax_tp decode scenario — ONE definition for the worker and the
+#: test's single-device reference, so they cannot drift apart
+TP_PROMPT = [5, 9, 2, 7]
+TP_STEPS = 6
+TP_MAX_SEQ = 32
+
+
 def main() -> None:
     leader_url = os.environ["GOFR_LEADER_URL"]
     host_id = os.environ["GOFR_HOST_ID"]
     mode = os.environ.get("GOFR_MODE", "plain")
     expect_world = int(os.environ.get("GOFR_EXPECT_WORLD", "2"))
 
-    if mode == "jax":
+    if mode in ("jax", "jax_tp"):
         import jax
         jax.config.update("jax_platforms", "cpu")
 
@@ -90,8 +102,66 @@ def main() -> None:
         evidence["collective"] = None
         evidence["collective_error"] = f"{type(exc).__name__}: {exc}"
     emit(**evidence)
+
+    if mode == "jax_tp":
+        # the full hand-off: tensor-parallel llama decode as ONE SPMD
+        # program spanning both OS processes — every matmul's
+        # collectives cross the process boundary
+        emit(event="tp_tokens", tokens=_tp_decode(jax))
     jax.distributed.shutdown()
     sys.exit(0)
+
+
+def _tp_decode(jax) -> list[int]:
+    """Greedy-decode a few tokens with the tiny llama tp-sharded over
+    every device of the 2-process mesh; returns the token ids (each
+    process computes the replicated logits, so both emit the same)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from gofr_tpu.models.llama import (LlamaConfig, llama_decode_step,
+                                       llama_init, llama_prefill_last,
+                                       make_empty_cache)
+    from gofr_tpu.parallel.mesh import create_mesh
+    from gofr_tpu.parallel.sharding import llama_param_specs, shard_params
+
+    config = LlamaConfig.tiny()
+    mesh = create_mesh({"tp": len(jax.devices())})
+    # identical seed in every process -> globally consistent host
+    # arrays; device_put slices out each process's addressable shards
+    params = shard_params(llama_init(jax.random.key(0), config),
+                          mesh, llama_param_specs(mesh))
+    replicated = NamedSharding(mesh, P())
+    kv_sh = NamedSharding(mesh, P(None, None, None, "tp", None))
+
+    n = len(TP_PROMPT)
+    prompt = jnp.asarray([TP_PROMPT], jnp.int32)
+    lengths = jnp.asarray([n], jnp.int32)
+
+    prefill = jax.jit(
+        lambda p, t, ln: llama_prefill_last(p, t, config, kv_lengths=ln,
+                                            implementation="xla"),
+        out_shardings=(replicated, (kv_sh, kv_sh)))
+    decode = jax.jit(
+        lambda p, tok, kc, vc, ln: llama_decode_step(
+            p, tok, kc, vc, ln, config),
+        out_shardings=(replicated, kv_sh, kv_sh))
+
+    k0, v0 = make_empty_cache(config, 1, max_seq=TP_MAX_SEQ)
+    logits, (k, v) = prefill(
+        params, jax.device_put(prompt, replicated),
+        jax.device_put(lengths, replicated))
+    # grow the prompt KV into a max_seq cache for decode
+    k = jax.device_put(k0, kv_sh).at[:, :, :n].set(k)
+    v = jax.device_put(v0, kv_sh).at[:, :, :n].set(v)
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    tokens = [int(np.asarray(tok)[0])]
+    for step in range(TP_STEPS - 1):
+        logits, k, v = decode(params, tok, k, v, lengths + step)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        tokens.append(int(np.asarray(tok)[0]))
+    return tokens
 
 
 if __name__ == "__main__":
