@@ -74,9 +74,13 @@ class GenRequest:
                                    # assigned once at first admission and
                                    # kept across preemption-requeues so a
                                    # re-admitted old request stays old
-    pending_prefill: bool = False  # mid chunked-prefill: holds a slot
-                                   # but must not decode yet
+    pending_prefill: bool = False  # mid chunked-prefill OR awaiting a
+                                   # dispatched batch prefill: holds a
+                                   # slot but must not decode yet
     prefill_offset: int = 0        # next chunk's start position
+    prefill_epoch: int = 0         # bumps per batch-prefill dispatch so
+                                   # a stale in-flight result can never
+                                   # attach to a requeued request
 
     def _emit(self, token: int | None) -> None:
         if self.out_queue is not None and self.loop is not None:
@@ -387,9 +391,11 @@ class Engine:
         # without a host sync (see the decode section comment)
         from collections import deque
         self._pending: Any = deque()
+        self._pending_prefills: Any = deque()
         self._dev_last: Any = None
         self._dev_last_reqs: list = [None] * cfg.max_batch
         self._decode_busy_until = 0.0
+        self._prefill_busy_until = 0.0
 
         self._rng_step = 0
         self._running = False
@@ -748,6 +754,7 @@ class Engine:
             self._fail(req, "prompt exceeds kv pool")
             return
         self._dev_last_reqs[slot] = None  # fresh/resumed occupant
+        req.prefill_epoch += 1  # orphan any in-flight batch prefill
         self.active[slot] = req
         req.slot = slot
         req.pending_prefill = True
@@ -773,8 +780,7 @@ class Engine:
                         self.active[slot] = None
                         req.prefill_offset = 0
                         self._requeue(req)
-                        self.stats["prefill_s"] += \
-                            time.perf_counter() - start
+                        self._note_prefill_span(start)
                         return
                     slot_arg = jnp.asarray(self._tables[slot:slot + 1])
                 else:
@@ -793,7 +799,7 @@ class Engine:
                 if off >= len(prompt):
                     break
             req.prefill_offset = off
-            self.stats["prefill_s"] += time.perf_counter() - start
+            self._note_prefill_span(start)
             if off < len(prompt):      # more chunks next pass
                 self._requeue(req)
                 return
@@ -950,8 +956,12 @@ class Engine:
         # the request re-enters by recompute with host-side state; a
         # surviving _dev_last entry from its old life in this slot must
         # never match it again (its generated[] diverges from the
-        # discarded in-flight pass)
+        # discarded in-flight pass), and neither may an in-flight batch
+        # prefill's first token (epoch bump) — the recompute re-admits
+        # through whichever prefill path fits its new prompt
         self._dev_last_reqs[slot] = None
+        req.pending_prefill = False
+        req.prefill_epoch += 1
         self.active[slot] = None
         self.lengths[slot] = 0
         self._release_pages(slot)
@@ -1143,9 +1153,7 @@ class Engine:
                 self.k_cache, self.v_cache, jnp.asarray(slots),
                 np.int32(self._rng_step), jnp.asarray(temps),
                 jnp.asarray(top_ps), jnp.asarray(top_ks))
-            toks_np = np.asarray(toks)
             self.stats["prefill_calls"] += 1
-            self.stats["prefill_s"] += time.perf_counter() - start
         except Exception as exc:
             for req in placed:
                 self.active[req.slot] = None
@@ -1157,20 +1165,78 @@ class Engine:
             self._recover_lost_cache(exc)
             return
 
-        now = time.time()
-        for row, req in enumerate(placed):
-            first = int(toks_np[row])
-            if req.first_token_at is None:  # not a preemption recompute
-                req.first_token_at = now
-                if self.metrics is not None:
-                    self.metrics.record_histogram(
-                        "app_chat_ttft_seconds", now - req.submitted_at)
-            req.generated.append(first)
-            req._emit(first)
-            self.total_generated += 1
-            self.lengths[req.slot] = len(req.prompt_tokens)
-            if self._finished(req, first):
-                self._retire(req.slot)
+        # PIPELINED: don't block on the first tokens here — the decode
+        # pass for everyone else dispatches first, and the tokens are
+        # collected when the device gets there (_collect_prefills).
+        # Until then the slots hold their requests but don't decode.
+        for req in placed:
+            req.pending_prefill = True
+            req.prefill_epoch += 1
+        self._pending_prefills.append({
+            "toks": toks,
+            "placed": list(placed),
+            "slots": [r.slot for r in placed],
+            "epochs": [r.prefill_epoch for r in placed],
+            "t0": start,
+        })
+
+    def _collect_prefills(self) -> None:
+        """Sync dispatched batch prefills: emit first tokens, open the
+        slots for decode. Requests whose slot changed hands or that
+        were re-dispatched since (epoch mismatch) are discarded — their
+        current life owns its own prefill."""
+        while self._pending_prefills:
+            rec = self._pending_prefills.popleft()
+            try:
+                toks_np = np.asarray(rec["toks"])
+            except Exception as exc:
+                for req, slot, epoch in zip(rec["placed"], rec["slots"],
+                                            rec["epochs"]):
+                    if req.prefill_epoch != epoch:
+                        continue  # re-dispatched elsewhere since
+                    req.pending_prefill = False
+                    if self.active[slot] is req:
+                        self.active[slot] = None
+                        if self.config.kv_layout == "paged":
+                            self._release_pages(slot)
+                    if req.finished_at is None:
+                        self._fail(req, str(exc))
+                if self.logger:
+                    self.logger.error(f"prefill failed: {exc!r}")
+                self._recover_lost_cache(exc)
+                continue
+            self._note_prefill_span(rec["t0"])
+            now = time.time()
+            for row, (req, slot, epoch) in enumerate(
+                    zip(rec["placed"], rec["slots"], rec["epochs"])):
+                if (req.prefill_epoch != epoch
+                        or self.active[slot] is not req
+                        or req.finished_at is not None):
+                    continue  # preempted/retired/re-admitted since
+                req.pending_prefill = False
+                first = int(toks_np[row])
+                if req.first_token_at is None:  # not a recompute
+                    req.first_token_at = now
+                    if self.metrics is not None:
+                        self.metrics.record_histogram(
+                            "app_chat_ttft_seconds",
+                            now - req.submitted_at)
+                req.generated.append(first)
+                req._emit(first)
+                self.total_generated += 1
+                self.lengths[slot] = len(req.prompt_tokens)
+                if self._finished(req, first):
+                    self._retire(slot)
+
+    def _note_prefill_span(self, start: float) -> None:
+        """prefill_s accumulates a UNION of dispatch→sync spans: two
+        bucket groups dispatched back-to-back and collected after the
+        same decode pass cover nearly the same wall interval — naive
+        sums would double-count (same watermark trick as decode_s)."""
+        end = time.perf_counter()
+        self.stats["prefill_s"] += end - max(start,
+                                             self._prefill_busy_until)
+        self._prefill_busy_until = end
 
     def _retire_unservable(self) -> None:
         """Shared pre-pass sweep: cancelled or at-ceiling slots leave
@@ -1634,14 +1700,17 @@ class Engine:
                     else:
                         self._spec_toggle = True
                         self._decode_step()
+                    self._collect_prefills()
                 else:
                     # nothing active: settle any in-flight pass so its
                     # final tokens reach their streams
                     self._drain_pending()
+                    self._collect_prefills()
                 self._update_gauges()
-            # clean stop with a pass still in flight: its tokens are
+            # clean stop with work still in flight: the tokens are
             # real — emit them before failing what remains
             self._drain_pending()
+            self._collect_prefills()
         except Exception as exc:  # containment: never die silently
             self._crash(exc)
         else:

@@ -142,3 +142,79 @@ def test_paged_native_engine_matches_slot_engine():
 
     assert all(r.error is None for r in got)
     assert [r.generated for r in got] == [r.generated for r in want]
+
+
+# ----------------------------------------- pipelined-prefill races
+
+def _unstarted_paged_engine(**cfg):
+    from gofr_tpu.serving.engine import EngineConfig
+    from gofr_tpu.serving.glue import demo_llama_engine
+
+    base = dict(max_batch=2, max_seq=128, seed=31, kv_layout="paged",
+                page_size=16)
+    base.update(cfg)
+    return demo_llama_engine(EngineConfig(**base))
+
+
+def test_stale_prefill_result_discarded_after_preempt():
+    """A batch prefill dispatched for request R must be discarded if R
+    was preempted before its first token was collected — the recompute
+    owns its own prefill (epoch protocol)."""
+    from gofr_tpu.serving.engine import SamplingParams
+
+    engine = _unstarted_paged_engine()
+    req = engine.submit([5, 9, 2], SamplingParams(temperature=0.0,
+                                                  max_new_tokens=6))
+    # drive the engine internals directly (loop not started)
+    engine._admit_batch([engine.waiting.pop_batch(1)[0]])
+    assert engine._pending_prefills and req.pending_prefill
+    slot = req.slot
+    engine._preempt(slot)                  # evicted before collect
+    assert not req.pending_prefill
+    engine._collect_prefills()             # stale: must emit NOTHING
+    assert req.generated == []
+    assert req.finished_at is None         # still live, just requeued
+    # the requeued life re-admits and produces its first token cleanly
+    batch, engine._requeued = engine._requeued, []
+    engine._requeued_set.clear()
+    engine._admit_batch(batch)
+    engine._collect_prefills()
+    assert len(req.generated) == 1
+    engine._shutdown_cleanup("test over")
+
+
+def test_cancelled_pending_prefill_discarded():
+    """Cancellation between prefill dispatch and collect retires the
+    slot; the late first token must not land after the terminal None."""
+    from gofr_tpu.serving.engine import SamplingParams
+
+    engine = _unstarted_paged_engine()
+    req = engine.submit([7, 7, 7], SamplingParams(temperature=0.0,
+                                                  max_new_tokens=6))
+    engine._admit_batch([engine.waiting.pop_batch(1)[0]])
+    req.cancelled = True
+    engine._retire_unservable()            # retires the pending slot
+    assert req.finished_at is not None
+    engine._collect_prefills()
+    assert req.generated == []             # nothing after the None
+    engine._shutdown_cleanup("test over")
+
+
+def test_prefill_spans_do_not_double_count():
+    """Two bucket groups dispatched back-to-back then collected
+    together must accumulate a UNION of wall spans, not a 2x sum."""
+    import time as _t
+
+    from gofr_tpu.serving.engine import SamplingParams
+
+    engine = _unstarted_paged_engine(max_batch=4)
+    t0 = _t.perf_counter()
+    for prompt in ([1] * 10, [2] * 40):    # two different buckets
+        engine.submit(prompt, SamplingParams(temperature=0.0,
+                                             max_new_tokens=4))
+    engine._admit_batch(engine.waiting.pop_batch(4))
+    assert len(engine._pending_prefills) == 2
+    engine._collect_prefills()
+    wall = _t.perf_counter() - t0
+    assert engine.stats["prefill_s"] <= wall + 0.01
+    engine._shutdown_cleanup("test over")
