@@ -1,0 +1,182 @@
+"""OpenAI-compatible surface: chat/completions (unary + SSE chunks),
+completions, models, stop sequences, error envelopes — through the
+real HTTP stack."""
+
+import json
+
+import pytest
+
+from gofr_tpu.serving.engine import EngineConfig
+from gofr_tpu.serving.glue import demo_llama_engine
+from gofr_tpu.serving.openai_compat import (_cut_at_stop,
+                                            install_openai_routes)
+from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+from .apputil import AppRunner
+
+
+@pytest.fixture(scope="module")
+def oa_app():
+    engine = demo_llama_engine(EngineConfig(max_batch=4, max_seq=128,
+                                            seed=41))
+    engine.start()
+
+    def build(app):
+        install_openai_routes(app, engine, ByteTokenizer(),
+                              model="tiny-llama")
+
+    runner = AppRunner(build=build)
+    with runner as app:
+        yield app, engine
+    engine.stop()
+
+
+def _post(app, path, body):
+    status, _, data = app.request("POST", path, body=body)
+    return status, json.loads(data)
+
+
+def test_models_list(oa_app):
+    app, _ = oa_app
+    status, body = app.get_json("/v1/models")
+    assert status == 200
+    assert body["object"] == "list"               # Raw: no envelope
+    assert body["data"][0]["id"] == "tiny-llama"
+
+
+def test_chat_completion_envelope(oa_app):
+    app, _ = oa_app
+    status, body = _post(app, "/v1/chat/completions", {
+        "model": "tiny-llama", "temperature": 0.0, "max_tokens": 7,
+        "messages": [{"role": "system", "content": "be brief"},
+                     {"role": "user", "content": "hi"}]})
+    assert status == 201
+    out = body.get("data", body)
+    assert out["object"] == "chat.completion"
+    assert out["id"].startswith("chatcmpl-")
+    choice = out["choices"][0]
+    assert choice["message"]["role"] == "assistant"
+    assert isinstance(choice["message"]["content"], str)
+    assert choice["finish_reason"] == "length"   # ran to max_tokens
+    assert out["usage"]["completion_tokens"] == 7
+    assert out["usage"]["total_tokens"] == \
+        out["usage"]["prompt_tokens"] + 7
+
+
+def test_text_completion(oa_app):
+    app, _ = oa_app
+    status, body = _post(app, "/v1/completions", {
+        "model": "tiny-llama", "prompt": "once upon",
+        "temperature": 0.0, "max_tokens": 5})
+    assert status == 201
+    out = body.get("data", body)
+    assert out["object"] == "text_completion"
+    assert out["id"].startswith("cmpl-")
+    assert isinstance(out["choices"][0]["text"], str)
+
+
+def test_streaming_chunks(oa_app):
+    import http.client
+
+    app, _ = oa_app
+    conn = http.client.HTTPConnection("127.0.0.1", app.port, timeout=60)
+    conn.request("POST", "/v1/chat/completions", body=json.dumps({
+        "model": "tiny-llama", "stream": True, "temperature": 0.0,
+        "max_tokens": 6,
+        "messages": [{"role": "user", "content": "go"}]}),
+        headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    raw = resp.read().decode()
+    conn.close()
+    events = [e[len("data: "):] for e in raw.split("\n\n")
+              if e.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    chunks = [json.loads(e) for e in events[:-1]]
+    assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+    assert chunks[0]["choices"][0]["delta"] == {"role": "assistant"}
+    text = "".join(c["choices"][0]["delta"].get("content", "")
+                   for c in chunks)
+    assert len(text) > 0
+    assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+    # the streamed text equals the unary text for the same request
+    status, body = _post(app, "/v1/chat/completions", {
+        "model": "tiny-llama", "temperature": 0.0, "max_tokens": 6,
+        "messages": [{"role": "user", "content": "go"}]})
+    unary = body.get("data", body)["choices"][0]["message"]["content"]
+    assert text == unary
+
+
+def test_stop_sequences(oa_app):
+    app, engine = oa_app
+    # discover the deterministic output, then stop on a piece of it
+    status, body = _post(app, "/v1/completions", {
+        "prompt": "stop test", "temperature": 0.0, "max_tokens": 10})
+    full = body.get("data", body)["choices"][0]["text"]
+    assert len(full) >= 3
+    marker = full[1:3]
+    status, body = _post(app, "/v1/completions", {
+        "prompt": "stop test", "temperature": 0.0, "max_tokens": 10,
+        "stop": [marker]})
+    out = body.get("data", body)
+    assert out["choices"][0]["text"] == full.split(marker)[0]
+    assert out["choices"][0]["finish_reason"] == "stop"
+
+
+def test_error_envelopes(oa_app):
+    app, _ = oa_app
+    status, body = _post(app, "/v1/chat/completions", {"messages": []})
+    assert status == 400
+    assert "messages" in body["error"]["message"] \
+        or body["error"]["details"]["param"] == "messages"
+    status, body = _post(app, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "x"}], "n": 3})
+    assert status == 400
+    status, body = _post(app, "/v1/completions", {
+        "prompt": "x", "stop": ["a", "b", "c", "d", "e"]})
+    assert status == 400
+
+
+def test_cut_at_stop_picks_earliest():
+    assert _cut_at_stop("abcdef", ["de", "bc"]) == ("a", True)
+    assert _cut_at_stop("abcdef", ["zz"]) == ("abcdef", False)
+
+
+def test_content_parts_and_null_optionals(oa_app):
+    """OpenAI SDK shapes: content-parts arrays render their text; an
+    explicit JSON null optional means 'use the default'."""
+    app, _ = oa_app
+    status, body = _post(app, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": [
+            {"type": "text", "text": "hel"},
+            {"type": "text", "text": "lo"}]}],
+        "temperature": None, "max_tokens": 4, "n": None})
+    assert status == 201, body
+    out = body.get("data", body)
+    assert out["usage"]["completion_tokens"] == 4
+    # non-text parts are rejected, not repr-mangled into the prompt
+    status, body = _post(app, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": [
+            {"type": "image_url", "image_url": {"url": "x"}}]}]})
+    assert status == 400
+    # bad n is a 400, not a 500
+    status, body = _post(app, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "x"}], "n": "abc"})
+    assert status == 400
+
+
+def test_unary_stop_cancels_generation(oa_app):
+    """A stop hit mid-drain cancels the engine request instead of
+    letting it burn the rest of its token budget."""
+    app, engine = oa_app
+    status, body = _post(app, "/v1/completions", {
+        "prompt": "cancel probe", "temperature": 0.0, "max_tokens": 10})
+    full = body.get("data", body)["choices"][0]["text"]
+    marker = full[1:3]
+    status, body = _post(app, "/v1/completions", {
+        "prompt": "cancel probe", "temperature": 0.0, "max_tokens": 90,
+        "stop": [marker]})
+    out = body.get("data", body)
+    assert out["choices"][0]["finish_reason"] == "stop"
+    assert out["choices"][0]["text"] == full.split(marker)[0]
+    # far fewer than 90 tokens were actually generated
+    assert out["usage"]["completion_tokens"] < 20
