@@ -1,9 +1,12 @@
 """TPU model serving — the flagship path with no reference
-counterpart: a Llama-family model behind /chat with continuous
-batching, TTFT metrics, and health showing engine state.
+counterpart: a Llama-family model behind /chat AND the
+OpenAI-compatible /v1 surface, with continuous batching, TTFT
+metrics, and health showing engine state.
 
 Uses the tiny config by default so it runs anywhere; set
-MODEL_PRESET=llama3_1b (etc.) on real hardware.
+MODEL_PRESET=llama3_1b (etc.) on real hardware, and MODEL_QUANT=int8
+for weight-only quantization (half the HBM traffic of the
+memory-bound decode).
 """
 
 from gofr_tpu.app import App, new_app
@@ -14,16 +17,20 @@ def build_app(config=None) -> App:
     from gofr_tpu.models.llama import LlamaConfig, llama_init
     from gofr_tpu.serving.engine import EngineConfig
     from gofr_tpu.serving.glue import llama_engine
+    from gofr_tpu.serving.openai_compat import install_openai_routes
+    from gofr_tpu.serving.tokenizer import ByteTokenizer
 
     app = new_app() if config is None else App(config=config)
-    preset = getattr(LlamaConfig,
-                     app.config.get_or_default("MODEL_PRESET", "tiny"))
-    model_config = preset()
+    preset_name = app.config.get_or_default("MODEL_PRESET", "tiny")
+    model_config = getattr(LlamaConfig, preset_name)()
     params = llama_init(jax.random.key(0), model_config)
-    engine = llama_engine(params, model_config,
-                          EngineConfig(max_batch=4,
-                                       max_seq=model_config.max_seq))
+    engine = llama_engine(
+        params, model_config,
+        EngineConfig(max_batch=4, max_seq=model_config.max_seq),
+        quantize=app.config.get_or_default("MODEL_QUANT", "") or None)
     app.serve_model("llama", engine)  # POST /chat + health + lifecycle
+    install_openai_routes(app, engine, ByteTokenizer(),
+                          model=preset_name)  # /v1/* (OpenAI clients)
     return app
 
 
