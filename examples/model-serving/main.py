@@ -3,10 +3,12 @@ counterpart: a Llama-family model behind /chat AND the
 OpenAI-compatible /v1 surface, with continuous batching, TTFT
 metrics, and health showing engine state.
 
-Uses the tiny config by default so it runs anywhere; set
-MODEL_PRESET=llama3_1b (etc.) on real hardware, and MODEL_QUANT=int8
-for weight-only quantization (half the HBM traffic of the
-memory-bound decode).
+Uses the tiny random-weight config by default so it runs anywhere.
+Point MODEL_PATH at an HF-format checkpoint directory
+(config.json + model.safetensors [+ tokenizer.json]) to serve real
+weights; or set MODEL_PRESET=llama3_1b (etc.) for a random-weight
+architecture twin. MODEL_QUANT=int8 enables weight-only quantization
+(half the HBM traffic of the memory-bound decode) in either mode.
 """
 
 from gofr_tpu.app import App, new_app
@@ -18,19 +20,42 @@ def build_app(config=None) -> App:
     from gofr_tpu.serving.engine import EngineConfig
     from gofr_tpu.serving.glue import llama_engine
     from gofr_tpu.serving.openai_compat import install_openai_routes
-    from gofr_tpu.serving.tokenizer import ByteTokenizer
+    from gofr_tpu.serving.tokenizer import BPETokenizer, ByteTokenizer
 
     app = new_app() if config is None else App(config=config)
-    preset_name = app.config.get_or_default("MODEL_PRESET", "tiny")
-    model_config = getattr(LlamaConfig, preset_name)()
-    params = llama_init(jax.random.key(0), model_config)
+    quant = app.config.get_or_default("MODEL_QUANT", "") or None
+    model_path = app.config.get_or_default("MODEL_PATH", "")
+    tokenizer = ByteTokenizer()
+    hf_tokenizer = False
+    if model_path:
+        from pathlib import Path
+
+        from gofr_tpu.models.hf_checkpoint import load_llama_checkpoint
+        max_seq = int(app.config.get_or_default("MODEL_MAX_SEQ", "8192"))
+        params, model_config = load_llama_checkpoint(
+            model_path, quantize=quant, max_seq=max_seq)
+        quant = None  # already applied on load
+        model_name = Path(model_path).name
+        tok_json = Path(model_path) / "tokenizer.json"
+        if tok_json.is_file():
+            tokenizer = BPETokenizer.from_hf_json(tok_json)
+            hf_tokenizer = True
+    else:
+        model_name = app.config.get_or_default("MODEL_PRESET", "tiny")
+        model_config = getattr(LlamaConfig, model_name)()
+        params = llama_init(jax.random.key(0), model_config)
     engine = llama_engine(
         params, model_config,
-        EngineConfig(max_batch=4, max_seq=model_config.max_seq),
-        quantize=app.config.get_or_default("MODEL_QUANT", "") or None)
-    app.serve_model("llama", engine)  # POST /chat + health + lifecycle
-    install_openai_routes(app, engine, ByteTokenizer(),
-                          model=preset_name)  # /v1/* (OpenAI clients)
+        EngineConfig(max_batch=4, max_seq=model_config.max_seq,
+                     # stop at end-of-text only when the checkpoint's
+                     # own tokenizer defined it — the byte-fallback's
+                     # eos_id would alias an ordinary vocab token
+                     eos_id=tokenizer.eos_id if hf_tokenizer else -1),
+        quantize=quant)
+    app.serve_model("llama", engine,
+                    tokenizer)  # POST /chat + health + lifecycle
+    install_openai_routes(app, engine, tokenizer,
+                          model=model_name)  # /v1/* (OpenAI clients)
     return app
 
 

@@ -253,7 +253,10 @@ class App:
                     chat_path: str | None = "/chat") -> None:
         """Wire a serving engine into the app: metrics, health, lifecycle,
         and (optionally) a chat endpoint, in one call."""
-        engine.metrics = self.container.metrics
+        if hasattr(engine, "attach_metrics"):
+            engine.attach_metrics(self.container.metrics)
+        else:
+            engine.metrics = self.container.metrics
         engine.logger = self.logger
         self.container.add_model(name, engine)
         if self.container.tpu is None:

@@ -12,7 +12,11 @@ for the whole round in the background:
     `scripts/tpu_results/<job>.json`, and moving the job file to
     `scripts/tpu_done/`;
   * all probe attempts and outcomes append to `scripts/tpu_state.jsonl`
-    so the session can check tunnel health at a glance.
+    so the session can check tunnel health at a glance;
+  * every result is stamped with the git SHA it ran against, and when
+    HEAD moves (a new commit lands) the whole canonical job set in
+    `scripts/tpu_jobs/` is re-enqueued so measurements never rot
+    against stale code.
 
 Jobs are plain python scripts run with cwd=repo root; they should print
 whatever artifact they produce (one JSON line by convention).  A job
@@ -32,6 +36,7 @@ import time
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 QUEUE = os.path.join(HERE, "tpu_queue")
+JOBS = os.path.join(HERE, "tpu_jobs")
 DONE = os.path.join(HERE, "tpu_done")
 RESULTS = os.path.join(HERE, "tpu_results")
 STATE = os.path.join(HERE, "tpu_state.jsonl")
@@ -91,6 +96,31 @@ def _probe() -> dict | None:
     return None
 
 
+def _head_sha() -> str:
+    try:
+        p = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                           capture_output=True, text=True, cwd=REPO, timeout=10)
+        return p.stdout.strip() if p.returncode == 0 else "?"
+    except Exception:
+        return "?"
+
+
+def _reenqueue_all(sha: str) -> int:
+    """Copy the canonical job set back into the queue (overwriting any
+    still-queued stale copy with fresh job code, attempts reset) so
+    the new commit gets measured; returns #jobs enqueued."""
+    n = 0
+    for name in sorted(os.listdir(JOBS)):
+        if not name.endswith(".py"):
+            continue
+        shutil.copy(os.path.join(JOBS, name), os.path.join(QUEUE, name))
+        _attempts.pop(name, None)
+        n += 1
+    if n:
+        _log({"event": "reenqueue", "sha": sha, "n": n})
+    return n
+
+
 _attempts: dict[str, int] = {}
 MAX_ATTEMPTS = 3
 
@@ -114,7 +144,7 @@ def _run_job(path: str) -> None:
     wall = round(time.time() - t0, 1)
     ok = rc == 0
     result = {"job": name, "ok": ok, "rc": rc, "wall_s": wall,
-              "attempt": _attempts[name],
+              "attempt": _attempts[name], "git_sha": _head_sha(),
               "stdout": out[-20000:], "stderr": err[-8000:],
               "ts": round(time.time(), 1)}
     with open(os.path.join(RESULTS, name + ".json"), "w") as f:
@@ -134,8 +164,13 @@ def main() -> None:
     for d in (QUEUE, DONE, RESULTS):
         os.makedirs(d, exist_ok=True)
     t_start = time.time()
-    _log({"event": "worker_start", "pid": os.getpid()})
+    _log({"event": "worker_start", "pid": os.getpid(), "sha": _head_sha()})
+    last_sha = _head_sha()
     while time.time() - t_start < MAX_RUNTIME_S:
+        sha = _head_sha()
+        if sha != "?" and sha != last_sha:  # "?" = transient git hiccup
+            last_sha = sha
+            _reenqueue_all(sha)
         jobs = sorted(f for f in os.listdir(QUEUE) if f.endswith(".py"))
         drained = False
         if jobs and _probe() is not None:

@@ -16,6 +16,19 @@ import time
 from gofr_tpu.config import DictConfig
 
 
+def grpc_channel(port: int):
+    """aio channel with a LOCAL subchannel pool. grpc's default global
+    pool shares live TCP subchannels across channels keyed by target,
+    so when the kernel recycles an ephemeral port across two test
+    servers in one process, a fresh channel can ride the dead server's
+    cached connection — observed as spurious UNAVAILABLE/INTERNAL on
+    the first RPC under a loaded suite."""
+    import grpc
+    return grpc.aio.insecure_channel(
+        f"127.0.0.1:{port}",
+        options=(("grpc.use_local_subchannel_pool", 1),))
+
+
 class AppRunner:
     def __init__(self, app=None, config: dict | None = None, build=None):
         from gofr_tpu.app import App

@@ -17,7 +17,7 @@ from gofr_tpu.grpc import (
     server_stream_rpc,
 )
 
-from .apputil import AppRunner
+from .apputil import AppRunner, grpc_channel
 
 
 @dataclass
@@ -93,7 +93,7 @@ class TestGRPC:
             port = r.app.grpc_server.bound_port
 
             async def go():
-                channel = grpc_lib.aio.insecure_channel(f"127.0.0.1:{port}")
+                channel = grpc_channel(port)
                 method = channel.unary_unary(
                     "/gofr.test.Greeter/WhoAmI",
                     request_serializer=lambda o: b"{}",
@@ -122,7 +122,7 @@ class TestGRPC:
 
             async def go():
                 import json
-                channel = grpc_lib.aio.insecure_channel(f"127.0.0.1:{port}")
+                channel = grpc_channel(port)
                 sum_rpc = channel.stream_unary(
                     "/gofr.test.Greeter/Sum",
                     request_serializer=lambda o: json.dumps(o).encode(),

@@ -19,7 +19,7 @@ from gofr_tpu.serving.grpc_chat import make_chat_service
 from gofr_tpu.serving.tokenizer import ByteTokenizer
 from gofr_tpu.grpc.health import _decode_varint
 
-from .apputil import AppRunner
+from .apputil import AppRunner, grpc_channel
 
 
 def run(coro):
@@ -82,7 +82,7 @@ def test_reflection_lists_services_over_the_wire():
         port = r.app.grpc_server.bound_port
 
         async def go():
-            channel = grpc_lib.aio.insecure_channel(f"127.0.0.1:{port}")
+            channel = grpc_channel(port)
             for svc in ("grpc.reflection.v1alpha.ServerReflection",
                         "grpc.reflection.v1.ServerReflection"):
                 method = channel.stream_stream(
@@ -107,7 +107,7 @@ def test_reflection_disabled_by_default():
         port = r.app.grpc_server.bound_port
 
         async def go():
-            channel = grpc_lib.aio.insecure_channel(f"127.0.0.1:{port}")
+            channel = grpc_channel(port)
             method = channel.stream_stream(
                 "/grpc.reflection.v1alpha.ServerReflection"
                 "/ServerReflectionInfo",
@@ -139,7 +139,7 @@ def test_grpc_chat_streaming_tokens():
         port = r.app.grpc_server.bound_port
 
         async def go():
-            channel = grpc_lib.aio.insecure_channel(f"127.0.0.1:{port}")
+            channel = grpc_channel(port)
             method = channel.unary_stream(
                 "/gofr.serving.Chat/Stream",
                 request_serializer=lambda o: json.dumps(o).encode(),
@@ -161,7 +161,7 @@ def test_grpc_chat_unary_complete_matches_stream():
         port = r.app.grpc_server.bound_port
 
         async def go():
-            channel = grpc_lib.aio.insecure_channel(f"127.0.0.1:{port}")
+            channel = grpc_channel(port)
             unary = channel.unary_unary(
                 "/gofr.serving.Chat/Complete",
                 request_serializer=lambda o: json.dumps(o).encode(),
@@ -192,7 +192,7 @@ def test_grpc_stream_client_cancel_cancels_request():
         engine = r.app._test_engine
 
         async def go():
-            channel = grpc_lib.aio.insecure_channel(f"127.0.0.1:{port}")
+            channel = grpc_channel(port)
             method = channel.unary_stream(
                 "/gofr.serving.Chat/Stream",
                 request_serializer=lambda o: json.dumps(o).encode(),
@@ -215,11 +215,21 @@ def test_grpc_stream_client_cancel_cancels_request():
 
         abandoned = run(go())
         assert abandoned is not None
+        # the engine free-runs between the client walking away and the
+        # server event loop delivering the cancel (a loaded suite can
+        # stretch that lag arbitrarily), so anchor the overshoot bound
+        # at the moment the ENGINE sees the flag, not at the client
+        # call: after req.cancelled is True, at most the in-flight
+        # pass plus one more can land before the retire sweep
         deadline = _time.time() + 30
+        while _time.time() < deadline and not abandoned.cancelled:
+            _time.sleep(0.01)
+        assert abandoned.cancelled
+        n_at_flag = len(abandoned.generated)
         while _time.time() < deadline and abandoned.finished_at is None:
             _time.sleep(0.05)
         assert abandoned.finished_at is not None
-        assert abandoned.cancelled
-        # max_seq=64 would cap at ~50 generated; cancel stops well short
-        assert len(abandoned.generated) <= 32, len(abandoned.generated)
+        K = engine.config.decode_steps_per_pass
+        assert len(abandoned.generated) <= n_at_flag + 2 * K, (
+            len(abandoned.generated), n_at_flag)
     r.app._test_engine.stop()

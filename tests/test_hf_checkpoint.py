@@ -1,0 +1,254 @@
+"""Real-weight ingestion: safetensors parsing, HF name/layout mapping,
+golden logits through a loaded checkpoint, tokenizer.json ingestion
+(VERDICT r4 missing #1)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gofr_tpu.models.hf_checkpoint import (
+    load_llama_checkpoint,
+    read_safetensors,
+    save_llama_checkpoint,
+    write_safetensors,
+)
+from gofr_tpu.models.llama import (
+    LlamaConfig,
+    llama_init,
+    llama_prefill_last,
+)
+from gofr_tpu.serving.tokenizer import BPETokenizer
+
+
+# ----------------------------------------------------- container format
+
+def test_safetensors_roundtrip_dtypes(tmp_path):
+    import ml_dtypes
+    tensors = {
+        "a": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "b": np.arange(6, dtype=np.int64).reshape(2, 3),
+        "c": np.linspace(-1, 1, 8).astype(ml_dtypes.bfloat16),
+        "d": np.array([True, False]),
+    }
+    path = tmp_path / "t.safetensors"
+    write_safetensors(path, tensors, metadata={"format": "pt"})
+    back = read_safetensors(path)
+    assert set(back) == set(tensors)
+    for name, want in tensors.items():
+        got = back[name]
+        assert got.dtype == want.dtype and got.shape == want.shape
+        assert np.array_equal(np.asarray(got), want), name
+
+
+def test_safetensors_header_is_standard(tmp_path):
+    """The header must be the documented layout — a foreign reader
+    (e.g. HF safetensors) should accept files we write."""
+    import struct
+    path = tmp_path / "t.safetensors"
+    write_safetensors(path, {"x": np.zeros((2, 2), np.float32)})
+    raw = path.read_bytes()
+    (hlen,) = struct.unpack("<Q", raw[:8])
+    header = json.loads(raw[8:8 + hlen])
+    assert header["x"] == {"dtype": "F32", "shape": [2, 2],
+                           "data_offsets": [0, 16]}
+    assert len(raw) == 8 + hlen + 16
+
+
+# ------------------------------------------------------ llama pytree map
+
+@pytest.fixture(scope="module")
+def tiny_checkpoint(tmp_path_factory):
+    """A tiny HF-format checkpoint on disk, from known params."""
+    cfg = LlamaConfig.tiny()
+    params = llama_init(jax.random.key(7), cfg)
+    directory = tmp_path_factory.mktemp("ckpt")
+    save_llama_checkpoint(params, cfg, directory)
+    return params, cfg, directory
+
+
+def test_checkpoint_writes_hf_names(tiny_checkpoint):
+    _, cfg, directory = tiny_checkpoint
+    names = set(read_safetensors(directory / "model.safetensors"))
+    assert "model.embed_tokens.weight" in names
+    assert "model.norm.weight" in names
+    assert "model.layers.0.self_attn.q_proj.weight" in names
+    assert f"model.layers.{cfg.n_layers - 1}.mlp.down_proj.weight" in names
+    # HF layout is [out_features, in_features]
+    tensors = read_safetensors(directory / "model.safetensors")
+    assert tensors["model.layers.0.self_attn.k_proj.weight"].shape == \
+        (cfg.n_kv_heads * cfg.head_dim, cfg.dim)
+    assert tensors["model.layers.0.mlp.gate_proj.weight"].shape == \
+        (cfg.ffn_dim, cfg.dim)
+    hf_cfg = json.loads((directory / "config.json").read_text())
+    assert hf_cfg["hidden_size"] == cfg.dim
+    assert hf_cfg["num_key_value_heads"] == cfg.n_kv_heads
+
+
+def test_load_roundtrips_params_exactly(tiny_checkpoint):
+    params, cfg, directory = tiny_checkpoint
+    loaded, lcfg = load_llama_checkpoint(directory, dtype=jnp.float32)
+    assert lcfg.dim == cfg.dim and lcfg.n_layers == cfg.n_layers
+    assert lcfg.tie_embeddings == cfg.tie_embeddings
+    flat_want = jax.tree.leaves_with_path(params)
+    flat_got = dict(jax.tree.leaves_with_path(loaded))
+    assert len(flat_want) == len(flat_got)
+    for path, want in flat_want:
+        got = flat_got[path]
+        assert got.shape == want.shape, path
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=0, atol=0, err_msg=str(path))
+
+
+def test_golden_logits_through_loaded_checkpoint(tiny_checkpoint):
+    """Forward pass on loaded weights must equal the source params'
+    forward pass bit-for-bit (same dtype, same graph)."""
+    params, cfg, directory = tiny_checkpoint
+    loaded, _ = load_llama_checkpoint(directory, dtype=jnp.float32)
+    tokens = jnp.array([[3, 1, 4, 1, 5, 9, 2, 6]], jnp.int32)
+    lengths = jnp.array([8], jnp.int32)
+    want, _ = llama_prefill_last(params, tokens, cfg, kv_lengths=lengths)
+    got, _ = llama_prefill_last(loaded, tokens, cfg, kv_lengths=lengths)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_load_sharded_index(tiny_checkpoint, tmp_path):
+    """model.safetensors.index.json + split shard files load the same."""
+    params, cfg, src = tiny_checkpoint
+    tensors = dict(read_safetensors(src / "model.safetensors"))
+    names = sorted(tensors)
+    half = len(names) // 2
+    shards = {"model-00001-of-00002.safetensors": names[:half],
+              "model-00002-of-00002.safetensors": names[half:]}
+    weight_map = {}
+    for fname, members in shards.items():
+        write_safetensors(tmp_path / fname,
+                          {n: tensors[n] for n in members})
+        weight_map.update({n: fname for n in members})
+    (tmp_path / "model.safetensors.index.json").write_text(
+        json.dumps({"weight_map": weight_map}))
+    (tmp_path / "config.json").write_text(
+        (src / "config.json").read_text())
+    loaded, _ = load_llama_checkpoint(tmp_path, dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(loaded["embed"]),
+                                  np.asarray(params["embed"]))
+    np.testing.assert_array_equal(
+        np.asarray(loaded["layers"]["w2"]),
+        np.asarray(params["layers"]["w2"]))
+
+
+def test_quantize_on_load(tiny_checkpoint):
+    _, cfg, directory = tiny_checkpoint
+    loaded, _ = load_llama_checkpoint(directory, quantize="int8")
+    from gofr_tpu.ops.quant import is_quantized
+    assert is_quantized(loaded["layers"]["wq"])
+    assert not is_quantized(loaded["final_norm"])
+
+
+def test_missing_tensor_is_a_clear_error(tmp_path, tiny_checkpoint):
+    _, cfg, src = tiny_checkpoint
+    tensors = dict(read_safetensors(src / "model.safetensors"))
+    tensors.pop("model.layers.1.mlp.up_proj.weight")
+    write_safetensors(tmp_path / "model.safetensors", tensors)
+    (tmp_path / "config.json").write_text(
+        (src / "config.json").read_text())
+    with pytest.raises(KeyError, match="up_proj"):
+        load_llama_checkpoint(tmp_path)
+
+
+def test_loaded_checkpoint_serves(tiny_checkpoint):
+    """The whole point: an on-disk checkpoint serves end to end."""
+    from gofr_tpu.serving.engine import EngineConfig, SamplingParams
+    from gofr_tpu.serving.glue import llama_engine
+
+    params, cfg, directory = tiny_checkpoint
+    loaded, lcfg = load_llama_checkpoint(directory, dtype=jnp.float32)
+    engine = llama_engine(loaded, lcfg,
+                          EngineConfig(max_batch=2, max_seq=64, seed=0))
+    engine.start()
+    try:
+        req = engine.submit_sync(
+            [5, 6, 7], SamplingParams(temperature=0.0, max_new_tokens=6))
+        assert req.error is None and len(req.generated) == 6
+        # greedy tokens from the SOURCE params must match exactly
+        ref = llama_engine(params, cfg,
+                           EngineConfig(max_batch=2, max_seq=64, seed=0))
+        ref.start()
+        try:
+            want = ref.submit_sync(
+                [5, 6, 7],
+                SamplingParams(temperature=0.0, max_new_tokens=6))
+            assert req.generated == want.generated
+        finally:
+            ref.stop()
+    finally:
+        engine.stop()
+
+
+# ------------------------------------------------------- tokenizer.json
+
+def _mini_tokenizer_json(tmp_path):
+    """A handcrafted byte-level BPE tokenizer.json: bytes for ascii,
+    merges building ' the' the way GPT-2-family files do."""
+    table_inv = {}  # byte -> unicode char used in the json
+    from gofr_tpu.serving.tokenizer import _byte_level_table
+    for ch, b in _byte_level_table().items():
+        table_inv[b] = ch
+
+    def enc(s: str) -> str:
+        return "".join(table_inv[b] for b in s.encode())
+
+    vocab = {}
+    for b in range(256):
+        vocab[table_inv[b]] = b
+    nxt = 256
+    for piece in ("th", "the", enc(" t"), enc(" th"), enc(" the"),
+                  "he", "at", "cat"):
+        if piece not in vocab:
+            vocab[piece] = nxt
+            nxt += 1
+    merges = ["t h", "th e", f"{enc(' ')} t", f"{enc(' t')} h",
+              f"{enc(' th')} e", "h e", "a t", "c at"]
+    spec = {
+        "model": {"type": "BPE", "vocab": vocab, "merges": merges},
+        "added_tokens": [
+            {"id": 300, "content": "<|begin_of_text|>"},
+            {"id": 301, "content": "<|end_of_text|>"},
+        ],
+    }
+    path = tmp_path / "tokenizer.json"
+    path.write_text(json.dumps(spec))
+    return path
+
+
+def test_hf_tokenizer_loads_and_encodes(tmp_path):
+    tok = BPETokenizer.from_hf_json(_mini_tokenizer_json(tmp_path))
+    assert tok.bos_id == 300 and tok.eos_id == 301
+    ids = tok.encode("the cat", bos=False)
+    # "the" merges fully; " cat" pretokenizes to " cat" whose bytes
+    # merge to " c"?? no — ' ' has no merge with 'c', so ' ' 'cat'
+    assert ids[0] == tok.ranks[b"the"]
+    assert tok.decode(ids) == "the cat"
+
+
+def test_hf_tokenizer_merge_priority_not_id_order(tmp_path):
+    """'at' (id 262) merges before 'cat' exists; priorities come from
+    the merges list, not vocab ids."""
+    tok = BPETokenizer.from_hf_json(_mini_tokenizer_json(tmp_path))
+    ids = tok.encode("cat", bos=False)
+    assert ids == [tok.ranks[b"cat"]]
+
+
+def test_hf_tokenizer_pretokenizer_keeps_spaces_lossless(tmp_path):
+    tok = BPETokenizer.from_hf_json(_mini_tokenizer_json(tmp_path))
+    for text in ("the the", " the\n\nthe", "a  b   c", "don't"):
+        assert tok.decode(tok.encode(text, bos=False)) == text
+
+
+def test_hf_tokenizer_roundtrips_unicode(tmp_path):
+    tok = BPETokenizer.from_hf_json(_mini_tokenizer_json(tmp_path))
+    text = "héllo wörld ☃"
+    assert tok.decode(tok.encode(text, bos=False)) == text

@@ -150,8 +150,11 @@ def _unstarted_paged_engine(**cfg):
     from gofr_tpu.serving.engine import EngineConfig
     from gofr_tpu.serving.glue import demo_llama_engine
 
+    # pipeline_depth=1 forces the pipelined regime these races live
+    # in: adaptive depth would collect prefills at admit time below
+    # pipeline_min_slots and the dispatch->collect window would vanish
     base = dict(max_batch=2, max_seq=128, seed=31, kv_layout="paged",
-                page_size=16)
+                page_size=16, pipeline_depth=1)
     base.update(cfg)
     return demo_llama_engine(EngineConfig(**base))
 

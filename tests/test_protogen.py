@@ -11,6 +11,8 @@ import pytest
 
 from gofr_tpu.grpc.protogen import generate, parse_proto
 
+from .apputil import grpc_channel
+
 PROTO = textwrap.dedent("""\
     syntax = "proto3";
 
@@ -107,8 +109,7 @@ def test_serve_and_call_with_generated_client(generated):
         server.register_descriptors(m.FILE_DESCRIPTOR_SET)
         await server.start()
         try:
-            async with grpc.aio.insecure_channel(
-                    f"127.0.0.1:{server.bound_port}") as channel:
+            async with grpc_channel(server.bound_port) as channel:
                 client = m.GreeterClient(channel)
                 reply = await client.SayHello(
                     m.HelloRequest(name="world"))
