@@ -128,10 +128,10 @@ def run_scenario(engine_cfg, prompts, gen_len, warm_lens,
     return reqs, wall, stats
 
 
-engine = EngineConfig(max_batch=max_batch, max_seq=model_config.max_seq,
-                      prefill_buckets=(64, 128, 256, 512), seed=0)
+base_cfg = EngineConfig(max_batch=max_batch, max_seq=model_config.max_seq,
+                        prefill_buckets=(64, 128, 256, 512), seed=0)
 prompt = list(range(1, prompt_len + 1))
-reqs, wall, stats = run_scenario(engine, [prompt] * n_requests, gen_len,
+reqs, wall, stats = run_scenario(base_cfg, [prompt] * n_requests, gen_len,
                                  (prompt_len,))
 
 ok = [r for r in reqs if r.error is None]

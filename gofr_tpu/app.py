@@ -245,9 +245,9 @@ class App:
             self._cron = Cron(self.container)
         self._cron.add(schedule, name, job)
 
-    def migrate(self, migrations: dict) -> None:
+    def migrate(self, migrations: dict) -> list[int]:
         from .migrations.runner import run as run_migrations
-        run_migrations(self.container, migrations)
+        return run_migrations(self.container, migrations)
 
     def serve_model(self, name: str, engine, tokenizer=None, *,
                     chat_path: str | None = "/chat") -> None:

@@ -29,11 +29,19 @@ class Datasource:
     handle is the open transaction."""
 
     def __init__(self, *, sql: Any = None, redis: Any = None, kv: Any = None,
-                 pubsub: Any = None, logger: Any = None) -> None:
+                 pubsub: Any = None, cassandra: Any = None,
+                 mongo: Any = None, clickhouse: Any = None,
+                 oracle: Any = None, scylladb: Any = None,
+                 logger: Any = None) -> None:
         self.sql = sql
         self.redis = redis
         self.kv = kv
         self.pubsub = pubsub
+        self.cassandra = cassandra
+        self.mongo = mongo
+        self.clickhouse = clickhouse
+        self.oracle = oracle
+        self.scylladb = scylladb
         self.logger = logger
 
 
@@ -102,6 +110,108 @@ class _KVStyleMigrator:
             "duration_ms": int((time.time() - started) * 1000)}))
 
 
+class _StatementMigrator:
+    """Ledger for stores speaking ``exec(stmt, *args)`` /
+    ``query(stmt, *args)`` with qmark placeholders — cassandra,
+    scylladb, clickhouse, oracle (reference builds one migrator per
+    initialized datasource, each with its own ledger:
+    migration/cassandra.go, clickhouse.go, migration.go:137-235).
+
+    ``ddls`` is tried in order: the store's native dialect first
+    (e.g. ClickHouse's MergeTree engine clause), then a generic
+    fallback for embedded/mini engines."""
+
+    def __init__(self, store: Any, ddls: tuple[str, ...]) -> None:
+        self.store = store
+        self.ddls = ddls
+
+    def ensure_ledger(self) -> None:
+        try:  # already there?
+            self.store.query(
+                f"SELECT version FROM {LEDGER_TABLE} WHERE version < 0")
+            return
+        except Exception:
+            pass
+        last_exc: Exception | None = None
+        for ddl in self.ddls:
+            try:
+                self.store.exec(ddl)
+                return
+            except Exception as exc:  # try the next dialect
+                last_exc = exc
+        raise MigrationError(
+            f"cannot create migration ledger: {last_exc}")
+
+    def last_version(self) -> int:
+        rows = self.store.query(f"SELECT version FROM {LEDGER_TABLE}")
+        versions = []
+        for row in rows:
+            value = row.get("version") if hasattr(row, "get") else None
+            if value is None and hasattr(row, "get"):
+                value = row.get("VERSION")
+            if value is not None:
+                versions.append(int(value))
+        return max(versions, default=0)
+
+    def record(self, version: int, started: float) -> None:
+        self.store.exec(
+            f"INSERT INTO {LEDGER_TABLE} "
+            "(version, method, start_time, duration_ms) "
+            "VALUES (?, ?, ?, ?)",
+            version, "UP",
+            time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(started)),
+            int((time.time() - started) * 1000))
+
+
+_CQL_LEDGER_DDLS = (
+    f"CREATE TABLE IF NOT EXISTS {LEDGER_TABLE} ("
+    "version BIGINT PRIMARY KEY, method TEXT, "
+    "start_time TEXT, duration_ms BIGINT)",
+)
+_CLICKHOUSE_LEDGER_DDLS = (
+    f"CREATE TABLE IF NOT EXISTS {LEDGER_TABLE} ("
+    "version Int64, method String, start_time String, "
+    "duration_ms Int64) ENGINE = MergeTree ORDER BY version",
+    # embedded/mini engines reject the ENGINE clause
+    f"CREATE TABLE IF NOT EXISTS {LEDGER_TABLE} ("
+    "version BIGINT PRIMARY KEY, method TEXT, "
+    "start_time TEXT, duration_ms BIGINT)",
+)
+_ORACLE_LEDGER_DDLS = (
+    # oracle has no IF NOT EXISTS; ensure_ledger probes first, and an
+    # 'already exists' race still lands in the generic fallback's error
+    f"CREATE TABLE {LEDGER_TABLE} ("
+    "version NUMBER PRIMARY KEY, method VARCHAR2(8), "
+    "start_time VARCHAR2(32), duration_ms NUMBER)",
+    f"CREATE TABLE IF NOT EXISTS {LEDGER_TABLE} ("
+    "version BIGINT PRIMARY KEY, method TEXT, "
+    "start_time TEXT, duration_ms BIGINT)",
+)
+
+
+class _MongoMigrator:
+    """Document ledger: one doc per version in a ``gofr_migrations``
+    collection (reference migration/mongo.go)."""
+
+    def __init__(self, store: Any) -> None:
+        self.store = store
+
+    def ensure_ledger(self) -> None:
+        pass  # collections need no DDL
+
+    def last_version(self) -> int:
+        docs = self.store.find(LEDGER_TABLE)
+        return max((int(d["version"]) for d in docs if "version" in d),
+                   default=0)
+
+    def record(self, version: int, started: float) -> None:
+        self.store.insert_one(LEDGER_TABLE, {
+            "version": version, "method": "UP",
+            "start_time": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                        time.gmtime(started)),
+            "duration_ms": int((time.time() - started) * 1000)})
+
+
 def run(container: Any, migrations: dict[int, Any]) -> list[int]:
     """Apply pending migrations; returns the versions that ran
     (reference migration.Run, migration.go:29-99)."""
@@ -115,17 +225,39 @@ def run(container: Any, migrations: dict[int, Any]) -> list[int]:
             raise MigrationError(f"migration {version} has no callable 'up'")
 
     sql_migrator = _SQLMigrator(container.sql) if container.sql else None
-    kv_migrators = [_KVStyleMigrator(store)
-                    for store in (container.redis, container.kv) if store]
-    if sql_migrator is None and not kv_migrators:
+    side_migrators: list[Any] = [
+        _KVStyleMigrator(store)
+        for store in (container.redis, container.kv) if store]
+    for slot, ddls in (("cassandra", _CQL_LEDGER_DDLS),
+                       ("scylladb", _CQL_LEDGER_DDLS),
+                       ("clickhouse", _CLICKHOUSE_LEDGER_DDLS),
+                       ("oracle", _ORACLE_LEDGER_DDLS)):
+        store = getattr(container, slot, None)
+        if store is not None:
+            side_migrators.append(_StatementMigrator(store, ddls))
+    if getattr(container, "mongo", None) is not None:
+        side_migrators.append(_MongoMigrator(container.mongo))
+    if sql_migrator is None and not side_migrators:
         raise MigrationError(
             "no datasource initialized to track migrations against")
 
     if sql_migrator:
         sql_migrator.ensure_ledger()
+    for migrator in side_migrators:
+        migrator.ensure_ledger()
     lasts = ([sql_migrator.last_version()] if sql_migrator else []) + \
-        [m.last_version() for m in kv_migrators]
+        [m.last_version() for m in side_migrators]
     last = max(lasts)
+
+    def facade(sql_handle: Any) -> Datasource:
+        return Datasource(sql=sql_handle, redis=container.redis,
+                          kv=container.kv, pubsub=container.pubsub,
+                          cassandra=getattr(container, "cassandra", None),
+                          mongo=getattr(container, "mongo", None),
+                          clickhouse=getattr(container, "clickhouse", None),
+                          oracle=getattr(container, "oracle", None),
+                          scylladb=getattr(container, "scylladb", None),
+                          logger=logger)
 
     applied: list[int] = []
     for version in sorted(migrations):
@@ -135,18 +267,16 @@ def run(container: Any, migrations: dict[int, Any]) -> list[int]:
         migration = migrations[version]
         if sql_migrator is not None:
             # transactional: the migration's SQL rides the tx and rolls
-            # back with the ledger row on failure (migration.go:68-97)
+            # back with the ledger row on failure (migration.go:68-97);
+            # the other stores have no cross-statement transactions —
+            # their ledger records only land after up() succeeds
             with container.sql.begin() as tx:
-                ds = Datasource(sql=tx, redis=container.redis,
-                                kv=container.kv, pubsub=container.pubsub,
-                                logger=logger)
+                ds = facade(tx)
                 migration.up(ds)
                 sql_migrator.record(tx, version, started)
         else:
-            ds = Datasource(redis=container.redis, kv=container.kv,
-                            pubsub=container.pubsub, logger=logger)
-            migration.up(ds)
-        for migrator in kv_migrators:
+            migration.up(facade(None))
+        for migrator in side_migrators:
             migrator.record(version, started)
         applied.append(version)
         logger.info(f"migration {version} applied in "

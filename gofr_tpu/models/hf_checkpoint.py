@@ -228,14 +228,28 @@ def load_llama_checkpoint(directory: str | Path, *,
             opened[path] = read_safetensors(path)
         return opened[path][name]
 
+    if quantize not in (None, "int8"):
+        raise ValueError(f"quantize must be None or 'int8', "
+                         f"got {quantize!r}")
+    if quantize is not None:
+        from ..ops.quant import quantize_int8
+
     c = config
     # cast straight from the memmap into the serving dtype: a float32
     # detour would transiently double host RAM on a 16 GB checkpoint
     target = np.dtype(c.dtype)
 
-    def to(a: np.ndarray, transpose: bool = False) -> Any:
+    def to(a: np.ndarray, transpose: bool = False,
+           quant_axis: int | None = None) -> Any:
         a = np.asarray(a).astype(target, copy=False)
-        return jnp.asarray(a.T if transpose else a)
+        if transpose:
+            a = a.T
+        if quantize is not None and quant_axis is not None:
+            # per-tensor quantize as each tensor lands on device: only
+            # this one tensor is ever full-precision there, never the
+            # whole tree (the point of quantize-on-LOAD)
+            return quantize_int8(jnp.asarray(a), axis=quant_axis)
+        return jnp.asarray(a)
 
     def stack(key: str, suffix: str, transpose: bool) -> Any:
         rows = [np.asarray(tensor(f"model.layers.{i}.{suffix}"))
@@ -243,23 +257,22 @@ def load_llama_checkpoint(directory: str | Path, *,
                 for i in range(c.n_layers)]
         if transpose:
             rows = [r.T for r in rows]
-        return jnp.asarray(np.stack(rows))  # the one full-size host copy
+        stacked = np.stack(rows)  # the one full-size host copy
+        # [L, in, out]: reduce the contraction axis (matches
+        # ops.quant.quantize_llama_int8); norm gains stay exact
+        quant_axis = None if key.endswith("_norm") else 1
+        return to(stacked, quant_axis=quant_axis)
 
     params: dict = {
-        "embed": to(tensor("model.embed_tokens.weight")),
+        # embed [V, D]: per-row scales serve gather AND the tied head
+        "embed": to(tensor("model.embed_tokens.weight"), quant_axis=1),
         "layers": {key: stack(key, suffix, tr)
                    for key, suffix, tr in _LAYER_MAP},
         "final_norm": to(tensor("model.norm.weight")),
     }
     if not c.tie_embeddings:
-        params["lm_head"] = to(tensor("lm_head.weight"), transpose=True)
-
-    if quantize is not None:
-        if quantize != "int8":
-            raise ValueError(f"quantize must be None or 'int8', "
-                             f"got {quantize!r}")
-        from ..ops.quant import quantize_llama_int8
-        params = quantize_llama_int8(params)
+        params["lm_head"] = to(tensor("lm_head.weight"), transpose=True,
+                               quant_axis=0)
     return params, config
 
 

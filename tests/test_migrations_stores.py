@@ -1,0 +1,170 @@
+"""Migration ledgers for the non-SQL store families the reference
+migrates — cassandra/scylla (CQL), clickhouse, oracle, mongo, and
+pub/sub topic-create — each store carrying its own ``gofr_migrations``
+ledger over the in-repo clients (reference
+pkg/gofr/migration/migration.go:137-235, cassandra.go, mongo.go;
+VERDICT r4 #7)."""
+
+from __future__ import annotations
+
+import pytest
+
+from gofr_tpu.config import DictConfig
+from gofr_tpu.container.container import Container
+from gofr_tpu.datasource.columnar import (
+    Cassandra,
+    Clickhouse,
+    Oracle,
+    ScyllaDB,
+)
+from gofr_tpu.migrations import Migrate, MigrationError, run
+
+
+def make_container(**stores) -> Container:
+    c = Container(config=DictConfig({}))
+    for slot, store in stores.items():
+        store.connect()
+        setattr(c, slot, store)
+    return c
+
+
+LEDGER_Q = "SELECT version FROM gofr_migrations"
+
+
+class TestCassandraMigrations:
+    def test_ledger_and_order(self):
+        c = make_container(cassandra=Cassandra())
+        applied = run(c, {
+            2: Migrate(up=lambda ds: ds.cassandra.exec(
+                "INSERT INTO spans (id) VALUES (1)")),
+            1: Migrate(up=lambda ds: ds.cassandra.exec(
+                "CREATE TABLE spans (id BIGINT PRIMARY KEY)")),
+        })
+        assert applied == [1, 2]
+        versions = [r["version"] for r in c.cassandra.query(LEDGER_Q)]
+        assert sorted(versions) == [1, 2]
+        assert len(c.cassandra.query("SELECT * FROM spans")) == 1
+
+    def test_rerun_is_idempotent(self):
+        c = make_container(cassandra=Cassandra())
+        migrations = {1: Migrate(up=lambda ds: ds.cassandra.exec(
+            "CREATE TABLE t1 (id BIGINT PRIMARY KEY)"))}
+        assert run(c, migrations) == [1]
+        assert run(c, migrations) == []
+
+    def test_scylla_uses_same_cql_ledger(self):
+        c = make_container(scylladb=ScyllaDB())
+        assert run(c, {1: Migrate(up=lambda ds: ds.scylladb.exec(
+            "CREATE TABLE s1 (id BIGINT PRIMARY KEY)"))}) == [1]
+        assert [r["version"] for r in c.scylladb.query(LEDGER_Q)] == [1]
+
+
+class TestClickhouseMigrations:
+    def test_ledger_and_data(self):
+        c = make_container(clickhouse=Clickhouse())
+        applied = run(c, {
+            1: Migrate(up=lambda ds: ds.clickhouse.exec(
+                "CREATE TABLE events (ts BIGINT, kind TEXT)")),
+            2: Migrate(up=lambda ds: ds.clickhouse.exec(
+                "INSERT INTO events (ts, kind) VALUES (1, 'boot')")),
+        })
+        assert applied == [1, 2]
+        assert [r["version"] for r in sorted(
+            c.clickhouse.query(LEDGER_Q), key=lambda r: r["version"])] \
+            == [1, 2]
+        assert c.clickhouse.query("SELECT kind FROM events")[0]["kind"] \
+            == "boot"
+
+    def test_rerun_is_idempotent(self):
+        c = make_container(clickhouse=Clickhouse())
+        migrations = {7: Migrate(up=lambda ds: ds.clickhouse.exec(
+            "CREATE TABLE e2 (id BIGINT)"))}
+        assert run(c, migrations) == [7]
+        assert run(c, migrations) == []
+
+
+class TestOracleMigrations:
+    def test_ledger_and_data(self):
+        c = make_container(oracle=Oracle())
+        applied = run(c, {
+            1: Migrate(up=lambda ds: ds.oracle.exec(
+                "CREATE TABLE accounts (id BIGINT PRIMARY KEY, "
+                "balance BIGINT)")),
+        })
+        assert applied == [1]
+        assert [r["version"] for r in c.oracle.query(LEDGER_Q)] == [1]
+        assert run(c, {1: Migrate(up=lambda ds: None)}) == []
+
+
+class TestMongoMigrations:
+    @pytest.fixture()
+    def mongo(self):
+        from gofr_tpu.datasource.mongo_wire import (
+            MiniMongoServer,
+            MongoWire,
+        )
+        server = MiniMongoServer()
+        server.start()
+        client = MongoWire(host="127.0.0.1", port=server.port,
+                           database="t")
+        client.connect()
+        yield client
+        client.close()
+        server.close()
+
+    def test_document_ledger(self, mongo):
+        c = Container(config=DictConfig({}))
+        c.mongo = mongo
+        applied = run(c, {
+            1: Migrate(up=lambda ds: ds.mongo.insert_one(
+                "users", {"name": "ada"})),
+            2: Migrate(up=lambda ds: ds.mongo.insert_one(
+                "users", {"name": "lin"})),
+        })
+        assert applied == [1, 2]
+        ledger = mongo.find("gofr_migrations")
+        assert sorted(d["version"] for d in ledger) == [1, 2]
+        assert run(c, {2: Migrate(up=lambda ds: None)}) == []
+        assert len(mongo.find("users")) == 2
+
+
+class TestCrossStoreLedgers:
+    def test_shared_last_version_across_stores(self):
+        """One run over sql+cassandra records both ledgers; a later
+        run against the same container skips what either ledger has
+        (reference records every initialized store's ledger)."""
+        from gofr_tpu.datasource.sql import SQL
+        sql = SQL()
+        sql.connect()
+        c = make_container(cassandra=Cassandra())
+        c.sql = sql
+        assert run(c, {1: Migrate(up=lambda ds: ds.cassandra.exec(
+            "CREATE TABLE x1 (id BIGINT PRIMARY KEY)"))}) == [1]
+        assert [r["version"] for r in c.cassandra.query(LEDGER_Q)] == [1]
+        assert [r["version"] for r in sql.query(LEDGER_Q)] == [1]
+        assert run(c, {1: Migrate(up=lambda ds: None)}) == []
+
+    def test_pubsub_topic_create_with_cassandra_ledger(self):
+        """Topic-create migrations (reference migration/pubsub.go)
+        tracked by a non-SQL ledger."""
+        from gofr_tpu.pubsub.inmemory import InMemoryBroker
+        c = make_container(cassandra=Cassandra())
+        c.pubsub = InMemoryBroker()
+        assert run(c, {1: Migrate(
+            up=lambda ds: ds.pubsub.create_topic("orders"))}) == [1]
+        assert "orders" in c.pubsub.topics
+        assert run(c, {1: Migrate(up=lambda ds: None)}) == []
+
+    def test_statement_store_failure_keeps_ledger_clean(self):
+        """A failing up() must leave no ledger record for that
+        version, so a rerun retries it."""
+        c = make_container(cassandra=Cassandra())
+
+        def boom(ds):
+            raise RuntimeError("mid-migration crash")
+
+        with pytest.raises(RuntimeError):
+            run(c, {1: Migrate(up=boom)})
+        assert c.cassandra.query(LEDGER_Q) == []
+        assert run(c, {1: Migrate(up=lambda ds: ds.cassandra.exec(
+            "CREATE TABLE ok (id BIGINT PRIMARY KEY)"))}) == [1]

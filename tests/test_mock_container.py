@@ -1,0 +1,183 @@
+"""Mock-container depth: gomock-style expectations with argument
+matching and unmet-expectation failure, plus the sqlmock-style SQL
+double (reference container/mock_container.go:93,
+container/sql_mock.go:12; VERDICT r4 #8)."""
+
+from __future__ import annotations
+
+import pytest
+
+from gofr_tpu.container.mock import (
+    CallRecorder,
+    ExpectationError,
+    MockContainer,
+    SQLMock,
+)
+
+
+class TestCallRecorderExpectations:
+    def test_expectation_matches_args_and_returns(self):
+        rec = CallRecorder("redis")
+        rec.expect_call("get").with_args("k1").returns("v1")
+        rec.expect_call("get").with_args("k2").returns("v2")
+        assert rec.get("k2") == "v2"
+        assert rec.get("k1") == "v1"
+        rec.verify()
+
+    def test_unexpected_args_fail_immediately(self):
+        rec = CallRecorder("redis")
+        rec.expect_call("get").with_args("k1").returns("v1")
+        with pytest.raises(ExpectationError, match="matching no open"):
+            rec.get("other")
+
+    def test_times_enforced_at_verify(self):
+        rec = CallRecorder("svc")
+        rec.expect_call("ping").times(2)
+        rec.ping()
+        with pytest.raises(ExpectationError, match="exactly 2x"):
+            rec.verify()
+        rec.ping()
+        rec.verify()
+
+    def test_times_cap_rejects_extra_calls(self):
+        rec = CallRecorder("svc")
+        rec.expect_call("ping").times(1)
+        rec.ping()
+        with pytest.raises(ExpectationError):
+            rec.ping()
+
+    def test_raises_expectation(self):
+        rec = CallRecorder("kv")
+        rec.expect_call("set").raises(RuntimeError("down"))
+        with pytest.raises(RuntimeError, match="down"):
+            rec.set("a", "b")
+
+    def test_loose_mode_still_works_without_declarations(self):
+        rec = CallRecorder("legacy")
+        rec.expect("keys", ["a"])
+        assert rec.keys() == ["a"]
+        assert rec.calls_to("keys") == [((), {})]
+        rec.verify()  # nothing declared, nothing unmet
+
+    def test_at_least_once_default(self):
+        rec = CallRecorder("svc")
+        rec.expect_call("flush")
+        with pytest.raises(ExpectationError, match="at least once"):
+            rec.verify()
+
+
+class TestSQLMock:
+    def test_query_rows_and_ordering(self):
+        m = SQLMock()
+        m.expect_query(r"SELECT \* FROM users").returns(
+            [{"id": 1, "name": "ada"}])
+        m.expect_exec(r"DELETE FROM users").with_args(1).affects(1)
+        assert m.query("SELECT * FROM users") == [{"id": 1, "name": "ada"}]
+        cur = m.exec("DELETE FROM users WHERE id = ?", 1)
+        assert cur.rowcount == 1  # cursor-shaped, like the real store
+        m.verify()
+
+    def test_affects_zero_drives_not_found_paths(self):
+        m = SQLMock()
+        m.expect_exec(r"UPDATE users").affects(0)
+        cur = m.exec("UPDATE users SET name = ? WHERE id = ?", "x", 99)
+        assert getattr(cur, "rowcount", 1) == 0  # crud's 404 check
+        m.verify()
+
+    def test_out_of_order_fails(self):
+        m = SQLMock()
+        m.expect_query(r"SELECT a").returns([])
+        m.expect_query(r"SELECT b").returns([])
+        with pytest.raises(ExpectationError, match="unexpected"):
+            m.query("SELECT b FROM t")
+
+    def test_unordered_mode(self):
+        m = SQLMock(ordered=False)
+        m.expect_query(r"SELECT a").returns([{"a": 1}])
+        m.expect_query(r"SELECT b").returns([{"b": 2}])
+        assert m.query("SELECT b FROM t") == [{"b": 2}]
+        assert m.query("SELECT a FROM t") == [{"a": 1}]
+        m.verify()
+
+    def test_arg_mismatch_fails(self):
+        m = SQLMock()
+        m.expect_exec(r"UPDATE").with_args("ada", 1).affects(1)
+        with pytest.raises(ExpectationError):
+            m.exec("UPDATE users SET name = ? WHERE id = ?", "lin", 1)
+
+    def test_unmet_statement_fails_verify(self):
+        m = SQLMock()
+        m.expect_exec(r"INSERT INTO audit").affects(1)
+        with pytest.raises(ExpectationError, match="never issued"):
+            m.verify()
+
+    def test_canned_error(self):
+        m = SQLMock()
+        m.expect_query(r"SELECT").raises(RuntimeError("db on fire"))
+        with pytest.raises(RuntimeError, match="on fire"):
+            m.query_row("SELECT 1")
+
+    def test_transaction_shares_expectations(self):
+        m = SQLMock()
+        m.expect_exec(r"INSERT INTO t").affects(1)
+        with m.begin() as tx:
+            tx.exec("INSERT INTO t (x) VALUES (?)", 5)
+        m.verify()
+
+    def test_select_binds_dataclasses(self):
+        import dataclasses
+
+        @dataclasses.dataclass
+        class User:
+            id: int
+            name: str
+
+        m = SQLMock()
+        m.expect_query(r"SELECT").returns([{"id": 3, "name": "lin"}])
+        assert m.select(User, "SELECT * FROM users") == [User(3, "lin")]
+
+
+class TestMockContainerIntegration:
+    def test_mock_sql_installs_and_verifies(self):
+        c = MockContainer()
+        sql = c.mock_sql()
+        sql.expect_query(r"SELECT 1").returns([{"one": 1}])
+        assert c.sql.query("SELECT 1") == [{"one": 1}]
+        c.verify()
+
+    def test_container_verify_covers_every_mock(self):
+        c = MockContainer()
+        redis = c.mock("redis")
+        redis.expect_call("get").with_args("x").returns("y")
+        with pytest.raises(ExpectationError, match="redis"):
+            c.verify()
+
+    def test_context_manager_verifies_on_clean_exit(self):
+        with pytest.raises(ExpectationError):
+            with MockContainer() as c:
+                c.mock_sql().expect_exec(r"INSERT").affects(1)
+                # exits cleanly without issuing the INSERT -> fails
+
+    def test_context_manager_does_not_mask_test_failure(self):
+        with pytest.raises(ValueError, match="real failure"):
+            with MockContainer() as c:
+                c.mock_sql().expect_exec(r"INSERT").affects(1)
+                raise ValueError("real failure")
+
+    def test_handler_against_sqlmock(self):
+        """A handler using container.sql runs hermetically against
+        declared statements — no sqlite behind it."""
+        from gofr_tpu.context import Context
+
+        def handler(ctx: Context):
+            row = ctx.sql.query_row(
+                "SELECT name FROM users WHERE id = ?", 7)
+            return {"hello": row["name"]}
+
+        c = MockContainer()
+        sql = c.mock_sql()
+        sql.expect_query(r"SELECT name FROM users").with_args(7) \
+            .returns([{"name": "ada"}])
+        ctx = Context(request=None, container=c)
+        assert handler(ctx) == {"hello": "ada"}
+        c.verify()
