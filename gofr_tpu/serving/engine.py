@@ -444,14 +444,18 @@ class Engine:
 
     def stop(self, join_timeout_s: float = 30.0) -> None:
         self._running = False
-        if self._thread is not None:
+        # snapshot: concurrent stop() calls are legal (handler + app
+        # shutdown hook), and another stopper may null self._thread
+        # between our check and use
+        thread = self._thread
+        if thread is not None:
             # the engine thread runs _shutdown_cleanup itself when the
             # loop exits, so a slow in-flight pass (e.g. a first-hit
             # compile outliving the join timeout) can never race
             # host-side cleanup: whoever finishes the loop retires the
             # streams, exactly once
-            self._thread.join(timeout=join_timeout_s)
-            if self._thread.is_alive():
+            thread.join(timeout=join_timeout_s)
+            if thread.is_alive():
                 # still mid device call (slow compile or wedged
                 # runtime): fail the *queued* requests now — the live
                 # thread only touches the queue via pop_batch, which
@@ -577,23 +581,26 @@ class Engine:
                 jnp.zeros(b, jnp.int32))
             jax.block_until_ready(toks)
         if chunked and self._prefill_chunk_fn is not None:
-            # compile the long-prompt chunk graph at every bucket width
-            # (the walk right-sizes each chunk, so tails and
-            # prefix-cache suffixes hit their own width), chunk_len 0:
-            # every cache write drops, the sample is discarded
+            # compile the chunk-walk graph at every bucket width for
+            # both group sizes the walk uses (solo and full wave) —
+            # all rows dummy (OOB slots/tables): every cache write
+            # drops, the samples are discarded
             fn = self._get_chunk_prefill()
-            if paged:  # an all-OOB table row: every gather clamps,
-                slot_arg = jnp.full((1, self._pages_per_slot),  # every
-                                    self._n_pages, jnp.int32)   # write
-            else:                                               # drops
-                slot_arg = np.int32(0)
+            P = max(1, cfg.prefill_batch)
             for width in self._usable_buckets:
-                toks, self.k_cache, self.v_cache = fn(
-                    self.params, jnp.zeros((1, width), jnp.int32),
-                    self.k_cache, self.v_cache, slot_arg, np.int32(0),
-                    np.int32(0), np.int32(0), np.float32(0.0),
-                    np.float32(1.0), np.int32(0))
-                jax.block_until_ready(toks)
+                for g in sorted({1, P}):
+                    if paged:
+                        slot_arg = jnp.full((g, self._pages_per_slot),
+                                            self._n_pages, jnp.int32)
+                    else:
+                        slot_arg = jnp.full(g, cfg.max_batch, jnp.int32)
+                    toks, self.k_cache, self.v_cache = fn(
+                        self.params, jnp.zeros((g, width), jnp.int32),
+                        self.k_cache, self.v_cache, slot_arg,
+                        jnp.zeros(g, jnp.int32), jnp.zeros(g, jnp.int32),
+                        np.int32(0), jnp.zeros(g, jnp.float32),
+                        jnp.ones(g, jnp.float32), jnp.zeros(g, jnp.int32))
+                    jax.block_until_ready(toks)
 
     def _clamp_prompt(self, tokens: list[int], max_new: int) -> list[int]:
         """Keep the tail of an over-long prompt, reserving room to
@@ -717,15 +724,17 @@ class Engine:
         return fn
 
     def _get_chunk_prefill(self) -> Callable:
-        """Fused single-slot chunk step: bring the slot's cache rows
-        into a contiguous view (a slice for the slot layout, a page
-        gather for the paged pool), run one chunk forward against the
-        history, splice the written rows back, and sample (only the
-        final chunk's sample is used). The jit retraces per chunk
-        width — long walks ride the widest bucket, a short tail (or a
-        prefix-cache suffix) pays for its own bucket, not the widest
-        (a [1, 512] forward for a 4-token suffix was the r4 bench's
-        prefix-hit slowdown)."""
+        """Fused G-slot chunk step: bring each walking slot's cache
+        rows into a contiguous view (an index gather for the slot
+        layout, a page gather for the paged pool), run one [G, width]
+        chunk forward against the histories, splice the written rows
+        back, and sample (only each row's final chunk's sample is
+        used). The jit retraces per (G, width) — an admission wave of
+        prefix-cache suffixes shares ONE dispatch instead of one per
+        request, and a short tail pays for its own bucket, not the
+        widest (a [1, 512] forward for a 4-token suffix was the r4
+        bench's prefix-hit slowdown). Dummy pad rows carry OOB
+        slots/tables, so their writes drop."""
         fn = self._prefill_cache.get("chunk")
         if fn is None:
             chunk_fn = self._prefill_chunk_fn
@@ -734,135 +743,51 @@ class Engine:
             if self.config.kv_layout == "paged":
                 from ..ops.paged_kv import gather_view, scatter_decode
 
-                def fused(params, tokens, kp, vp, table_row, offset,
-                          chunk_len, step, temp, top_p, top_k):
+                def fused(params, tokens, kp, vp, tables, offsets,
+                          chunk_lens, step, temps, top_ps, top_ks):
                     width = tokens.shape[1]
-                    k_view = gather_view(kp, table_row)
-                    v_view = gather_view(vp, table_row)
+                    k_view = gather_view(kp, tables)
+                    v_view = gather_view(vp, tables)
                     logits, k_view, v_view = chunk_fn(
-                        params, tokens, k_view, v_view, offset[None],
-                        chunk_len[None])
-                    # write back exactly the chunk's row range; rows
+                        params, tokens, k_view, v_view, offsets,
+                        chunk_lens)
+                    # write back exactly each row's chunk range; rows
                     # beyond chunk_len round-trip their gathered values
-                    # and unallocated pages drop
-                    kp = scatter_decode(kp, table_row,
+                    # and unallocated (dummy) pages drop
+                    kp = scatter_decode(kp, tables,
                                         k_view.astype(kp.dtype),
-                                        offset[None], width)
-                    vp = scatter_decode(vp, table_row,
+                                        offsets, width)
+                    vp = scatter_decode(vp, tables,
                                         v_view.astype(vp.dtype),
-                                        offset[None], width)
+                                        offsets, width)
                     key = jax.random.fold_in(base_key, step)
-                    tok = _sample_batch(logits, key, temp[None],
-                                        top_p[None], top_k[None])[0]
-                    return tok, kp, vp
+                    toks = _sample_batch(logits, key, temps,
+                                         top_ps, top_ks)
+                    return toks, kp, vp
             else:
-                def fused(params, tokens, kc, vc, slot, offset,
-                          chunk_len, step, temp, top_p, top_k):
-                    kcs = jax.lax.dynamic_slice_in_dim(kc, slot, 1,
-                                                       axis=1)
-                    vcs = jax.lax.dynamic_slice_in_dim(vc, slot, 1,
-                                                       axis=1)
+                def fused(params, tokens, kc, vc, slots, offsets,
+                          chunk_lens, step, temps, top_ps, top_ks):
+                    # dummy rows: gather clips to a real slot (read-
+                    # only, harmless), scatter drops their write-back
+                    kcs = jnp.take(kc, slots, axis=1, mode="clip")
+                    vcs = jnp.take(vc, slots, axis=1, mode="clip")
                     logits, kcs, vcs = chunk_fn(
-                        params, tokens, kcs, vcs, offset[None],
-                        chunk_len[None])
-                    kc = jax.lax.dynamic_update_slice_in_dim(
-                        kc, kcs.astype(kc.dtype), slot, axis=1)
-                    vc = jax.lax.dynamic_update_slice_in_dim(
-                        vc, vcs.astype(vc.dtype), slot, axis=1)
+                        params, tokens, kcs, vcs, offsets, chunk_lens)
+                    kc = kc.at[:, slots].set(kcs.astype(kc.dtype),
+                                             mode="drop")
+                    vc = vc.at[:, slots].set(vcs.astype(vc.dtype),
+                                             mode="drop")
                     key = jax.random.fold_in(base_key, step)
-                    tok = _sample_batch(logits, key, temp[None],
-                                        top_p[None], top_k[None])[0]
-                    return tok, kc, vc
+                    toks = _sample_batch(logits, key, temps,
+                                         top_ps, top_ks)
+                    return toks, kc, vc
             fn = jax.jit(fused, donate_argnums=(2, 3))
             self._prefill_cache["chunk"] = fn
         return fn
 
-    def _prefill_long(self, req: GenRequest, slot: int) -> None:
-        """Admit (or resume) a prompt longer than the widest bucket:
-        walk it in bucket-width chunks, each attending to the rows the
-        previous chunks wrote — no truncation (long-context
-        obligation). At most ``prefill_chunks_per_pass`` chunks run per
-        call; an unfinished walk requeues itself so decode for every
-        other slot interleaves instead of head-of-line blocking."""
-        cfg = self.config
-        paged = cfg.kv_layout == "paged"
-        widest = max(self._usable_buckets)
-        prompt = req.prompt_tokens
-        if paged and -(-(len(prompt) + 1) // cfg.page_size) > self._n_pages:
-            # an attached prefix (incref'd before this call) must not
-            # leak into the slot's table for the next occupant
-            self._release_pages(slot)
-            req.prefill_offset = 0
-            self._fail(req, "prompt exceeds kv pool")
-            return
-        self._dev_last_reqs[slot] = None  # fresh/resumed occupant
-        req.prefill_epoch += 1  # orphan any in-flight batch prefill
-        self.active[slot] = req
-        req.slot = slot
-        req.pending_prefill = True
-        if paged and req.admit_order < 0:
-            req.admit_order = self._admit_seq
-            self._admit_seq += 1
-        self._rng_step += 1
-        start = time.perf_counter()
-        try:
-            fn = self._get_chunk_prefill()
-            tok_dev = None
-            off = req.prefill_offset
-            for _ in range(max(1, int(cfg.prefill_chunks_per_pass))):
-                # smallest bucket covering what's left: the last chunk
-                # of a walk and prefix-cache suffixes run a graph their
-                # own size instead of the widest
-                remaining = len(prompt) - off
-                width = next((b for b in self._usable_buckets
-                              if b >= remaining), widest)
-                chunk = prompt[off:off + width]
-                if paged:
-                    rows = min(off + len(chunk) + 1, cfg.max_seq)
-                    if not self._ensure_headroom(slot, rows):
-                        # the pool can't cover this walk even after
-                        # preempting younger requests: release and
-                        # restart from scratch once pages free up
-                        self._release_pages(slot)
-                        self._dev_last_reqs[slot] = None
-                        self.active[slot] = None
-                        req.prefill_offset = 0
-                        self._requeue(req)
-                        self._note_prefill_span(start)
-                        return
-                    slot_arg = jnp.asarray(self._tables[slot:slot + 1])
-                else:
-                    slot_arg = np.int32(slot)
-                tokens = np.zeros((1, width), np.int32)
-                tokens[0, :len(chunk)] = chunk
-                tok_dev, self.k_cache, self.v_cache = fn(
-                    self.params, jnp.asarray(tokens), self.k_cache,
-                    self.v_cache, slot_arg, np.int32(off),
-                    np.int32(len(chunk)), np.int32(self._rng_step),
-                    np.float32(req.params.temperature),
-                    np.float32(req.params.top_p),
-                    np.int32(req.params.top_k))
-                self.stats["prefill_calls"] += 1
-                off += len(chunk)
-                if off >= len(prompt):
-                    break
-            req.prefill_offset = off
-            self._note_prefill_span(start)
-            if off < len(prompt):      # more chunks next pass
-                self._requeue(req)
-                return
-            first = int(np.asarray(tok_dev))
-        except Exception as exc:
-            self.active[slot] = None
-            if paged:
-                self._release_pages(slot)
-            req.pending_prefill = False
-            self._fail(req, str(exc))
-            if self.logger:
-                self.logger.error(f"chunked prefill failed: {exc!r}")
-            self._recover_lost_cache(exc)
-            return
-
+    def _finish_walk(self, req: GenRequest, first: int) -> None:
+        """A chunk walk covered its whole prompt: emit the first
+        sampled token and open the slot for decode."""
         req.pending_prefill = False
         now = time.time()
         if req.first_token_at is None:  # not a preemption recompute
@@ -873,9 +798,163 @@ class Engine:
         req.generated.append(first)
         req._emit(first)
         self.total_generated += 1
-        self.lengths[slot] = len(prompt)
+        self.lengths[req.slot] = len(req.prompt_tokens)
         if self._finished(req, first):
-            self._retire(slot)
+            self._retire(req.slot)
+
+    def _walk_chunks(self, pairs: list) -> None:
+        """Admit (or resume) prompts through the chunk-with-history
+        walk — prompts longer than the widest bucket, prefix-cache
+        suffixes, preemption recomputes — BATCHED: walkers entering
+        together share [G, width] device calls grouped by chunk width,
+        so an admission wave of same-system-prompt suffixes costs
+        ceil(G/prefill_batch) dispatches instead of G (each dispatch
+        is a host round trip; over a device tunnel those dominate the
+        wave). At most ``prefill_chunks_per_pass`` chunk rounds run
+        per call; unfinished walks requeue so decode for every other
+        slot interleaves instead of head-of-line blocking."""
+        cfg = self.config
+        paged = cfg.kv_layout == "paged"
+        widest = max(self._usable_buckets)
+        P = max(1, cfg.prefill_batch)
+        walkers: list[GenRequest] = []
+        for req, slot in pairs:
+            prompt = req.prompt_tokens
+            if paged and -(-(len(prompt) + 1) // cfg.page_size) \
+                    > self._n_pages:
+                # an attached prefix (incref'd before this call) must
+                # not leak into the slot's table for the next occupant
+                self._release_pages(slot)
+                if self.active[slot] is req:  # admit-time reservation
+                    self.active[slot] = None
+                req.prefill_offset = 0
+                self._fail(req, "prompt exceeds kv pool")
+                continue
+            self._dev_last_reqs[slot] = None  # fresh/resumed occupant
+            req.prefill_epoch += 1  # orphan any in-flight batch prefill
+            self.active[slot] = req
+            req.slot = slot
+            req.pending_prefill = True
+            if paged and req.admit_order < 0:
+                req.admit_order = self._admit_seq
+                self._admit_seq += 1
+            walkers.append(req)
+        if not walkers:
+            return
+
+        def owns_slot(r: GenRequest) -> bool:
+            return (r.finished_at is None and r.slot >= 0
+                    and self.active[r.slot] is r)
+
+        start = time.perf_counter()
+        dispatched: list[GenRequest] = []  # rows of the in-flight call
+        try:
+            fn = self._get_chunk_prefill()
+            for _ in range(max(1, int(cfg.prefill_chunks_per_pass))):
+                live = [r for r in walkers if owns_slot(r)
+                        and r.prefill_offset < len(r.prompt_tokens)]
+                if not live:
+                    break
+                # smallest bucket covering each walker's remainder —
+                # the last chunk of a walk and prefix-cache suffixes
+                # run a graph their own size, not the widest
+                by_width: dict[int, list[GenRequest]] = {}
+                for r in live:
+                    remaining = len(r.prompt_tokens) - r.prefill_offset
+                    width = next((b for b in self._usable_buckets
+                                  if b >= remaining), widest)
+                    by_width.setdefault(width, []).append(r)
+                for width, group in by_width.items():
+                    for i in range(0, len(group), P):
+                        ready = []
+                        for r in group[i:i + P]:
+                            if not owns_slot(r):
+                                continue  # a peer's headroom preempted it
+                            if paged:
+                                chunk_len = min(
+                                    width,
+                                    len(r.prompt_tokens) - r.prefill_offset)
+                                rows = min(r.prefill_offset + chunk_len + 1,
+                                           cfg.max_seq)
+                                if not self._ensure_headroom(r.slot, rows):
+                                    # the pool can't cover this walk even
+                                    # after preempting younger requests:
+                                    # release and restart from scratch
+                                    # once pages free up
+                                    self._release_pages(r.slot)
+                                    self._dev_last_reqs[r.slot] = None
+                                    self.active[r.slot] = None
+                                    r.prefill_offset = 0
+                                    self._requeue(r)
+                                    continue
+                            ready.append(r)
+                        ready = [r for r in ready if owns_slot(r)]
+                        if not ready:
+                            continue
+                        # pad to the full group: only (1, P) variants
+                        # ever compile per width
+                        G = 1 if len(ready) == 1 else P
+                        tokens = np.zeros((G, width), np.int32)
+                        offs = np.zeros(G, np.int32)
+                        lens = np.zeros(G, np.int32)
+                        temps = np.zeros(G, np.float32)
+                        top_ps = np.ones(G, np.float32)
+                        top_ks = np.zeros(G, np.int32)
+                        if paged:  # dummy rows all-OOB: writes drop
+                            slots_arg = np.full(
+                                (G, self._pages_per_slot), self._n_pages,
+                                np.int32)
+                        else:
+                            slots_arg = np.full(G, cfg.max_batch, np.int32)
+                        for row, r in enumerate(ready):
+                            chunk = r.prompt_tokens[
+                                r.prefill_offset:r.prefill_offset + width]
+                            tokens[row, :len(chunk)] = chunk
+                            offs[row] = r.prefill_offset
+                            lens[row] = len(chunk)
+                            temps[row] = r.params.temperature
+                            top_ps[row] = r.params.top_p
+                            top_ks[row] = r.params.top_k
+                            slots_arg[row] = self._tables[r.slot] \
+                                if paged else r.slot
+                        self._rng_step += 1
+                        dispatched = ready
+                        toks, self.k_cache, self.v_cache = fn(
+                            self.params, jnp.asarray(tokens),
+                            self.k_cache, self.v_cache,
+                            jnp.asarray(slots_arg), jnp.asarray(offs),
+                            jnp.asarray(lens), np.int32(self._rng_step),
+                            jnp.asarray(temps), jnp.asarray(top_ps),
+                            jnp.asarray(top_ks))
+                        self.stats["prefill_calls"] += 1
+                        toks_np = None
+                        for row, r in enumerate(ready):
+                            r.prefill_offset += int(lens[row])
+                            if r.prefill_offset >= len(r.prompt_tokens):
+                                if toks_np is None:
+                                    toks_np = np.asarray(toks)
+                                self._finish_walk(r, int(toks_np[row]))
+                        dispatched = []
+        except Exception as exc:
+            # fail the rows of the crashing dispatch; walkers that
+            # were not in it keep their state and requeue below
+            for r in (dispatched or
+                      [w for w in walkers if owns_slot(w)
+                       and w.pending_prefill]):
+                if r.slot >= 0 and self.active[r.slot] is r:
+                    self.active[r.slot] = None
+                    if paged:
+                        self._release_pages(r.slot)
+                r.pending_prefill = False
+                self._fail(r, str(exc))
+            if self.logger:
+                self.logger.error(f"chunked prefill failed: {exc!r}")
+            self._recover_lost_cache(exc)
+        self._note_prefill_span(start)
+        for r in walkers:  # more chunks next pass
+            if owns_slot(r) and r.pending_prefill \
+                    and r.prefill_offset < len(r.prompt_tokens):
+                self._requeue(r)
 
     def _free_slot(self) -> int:
         for i, r in enumerate(self.active):
@@ -1092,7 +1171,17 @@ class Engine:
         chunks of ``prefill_batch`` with one device call per chunk.
         Prompts wider than every bucket take the chunked path."""
         by_bucket: dict[int, list[GenRequest]] = {}
+        walkers: list = []
         widest = max(self._usable_buckets)
+
+        def reserve_for_walk(req: GenRequest, slot: int) -> None:
+            # hold the slot NOW: walkers dispatch together after the
+            # bucket groups, and _free_slot must not hand their slot
+            # to a later request in this same batch
+            self.active[slot] = req
+            req.slot = slot
+            walkers.append((req, slot))
+
         for req in reqs:
             if req.finished_at is not None:
                 continue  # failed/retired while queued
@@ -1101,7 +1190,7 @@ class Engine:
                 continue  # already serving (stale duplicate entry)
             if req.pending_prefill:  # resuming a chunk walk
                 if req.slot >= 0 and self.active[req.slot] is req:
-                    self._prefill_long(req, req.slot)
+                    walkers.append((req, req.slot))
                 elif req.finished_at is None:
                     # slot lost (pool-exhaustion restart / preemption):
                     # re-admit from scratch
@@ -1109,7 +1198,7 @@ class Engine:
                     if slot < 0:
                         self._requeue(req)
                     else:
-                        self._prefill_long(req, slot)
+                        reserve_for_walk(req, slot)
                 continue
             if self._prefix_enabled and req.prefill_offset == 0:
                 covered = self._probe_prefix(req.prompt_tokens)
@@ -1123,7 +1212,7 @@ class Engine:
                         self._attach_prefix(slot, req.prompt_tokens,
                                             covered)
                         req.prefill_offset = covered
-                        self._prefill_long(req, slot)
+                        reserve_for_walk(req, slot)
                     continue
             if (self._prefill_chunk_fn is not None
                     and len(req.prompt_tokens) > widest):
@@ -1131,7 +1220,7 @@ class Engine:
                 if slot < 0:  # raced out of slots; try next pass
                     self._requeue(req)
                 else:
-                    self._prefill_long(req, slot)
+                    reserve_for_walk(req, slot)
                 continue
             bucket = self._bucket_for(len(req.prompt_tokens))
             by_bucket.setdefault(bucket, []).append(req)
@@ -1139,6 +1228,10 @@ class Engine:
         for bucket, group in by_bucket.items():
             for i in range(0, len(group), P):
                 self._prefill_group(bucket, group[i:i + P])
+        if walkers:
+            # after the bucket dispatches: their device work overlaps
+            # the walk's synchronous rounds
+            self._walk_chunks(walkers)
         # below the pipelining threshold the decode pass these prefills
         # would hide behind is cheap and TTFT is the scarce resource —
         # sync first tokens out now instead of after the next pass

@@ -109,7 +109,10 @@ def test_stream_engine_failure_visible_in_sse():
     import threading
 
     tokenizer = ByteTokenizer()
-    engine = demo_llama_engine(EngineConfig(max_batch=2, max_seq=128))
+    # a deep sequence keeps the doomed stream ALIVE until stop() lands:
+    # at max_seq=128 the generation caps out in ~50 ms and a loaded box
+    # can finish (emitting [DONE]) before the stop thread is scheduled
+    engine = demo_llama_engine(EngineConfig(max_batch=2, max_seq=4096))
     engine.start()
     try:
         with AppRunner() as runner:
@@ -205,9 +208,12 @@ def test_concurrent_chat_over_http(serving_app):
     import concurrent.futures as futures
 
     def one(i):
+        # 8 concurrent generations on a loaded CI box can exceed the
+        # 10s default while the suite churns around them
         status, _, data = serving_app.request(
             "POST", "/chat",
-            {"prompt": f"req {i}", "max_tokens": 4, "temperature": 0.0})
+            {"prompt": f"req {i}", "max_tokens": 4, "temperature": 0.0},
+            timeout=60)
         return status, json.loads(data)
 
     with futures.ThreadPoolExecutor(8) as pool:
