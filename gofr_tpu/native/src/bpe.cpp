@@ -8,11 +8,23 @@
 // (gofr_tpu/serving/tokenizer.py:_bpe_merge), called through ctypes
 // (which releases the GIL, so tokenization overlaps device steps).
 //
+// Two vocabulary styles share the loop:
+//   * tiktoken: the output id IS the merge priority (ranks only);
+//   * HF tokenizer.json: merge priority comes from the merges list,
+//     output ids from the vocab — bpe_add_merge switches the pair
+//     lookup to the merge table while final emission keeps ranks.
+// Pre-tokenizer boundaries (HF splits text with a regex before BPE)
+// ride the same native call as byte offsets merges may not cross, so
+// a whole request still tokenizes in ONE GIL-released call.
+//
 // C ABI:
 //   bpe_create() -> handle
 //   bpe_add_token(handle, bytes, len, rank)   // build vocabulary
+//   bpe_add_merge(handle, bytes, len, prio)   // optional HF merge table
 //   bpe_finalize(handle)                      // index pairs
 //   bpe_encode(handle, text, len, out, cap) -> n tokens (or -1 overflow)
+//   bpe_encode_bounded(handle, text, len, bounds, nbounds, out, cap)
+//       // bounds: sorted byte offsets starting a new piece
 //   bpe_destroy(handle)
 
 #include <cstdint>
@@ -25,7 +37,9 @@
 namespace {
 
 struct Encoder {
-    std::unordered_map<std::string, int32_t> ranks;
+    std::unordered_map<std::string, int32_t> ranks;   // piece -> id
+    std::unordered_map<std::string, int32_t> merges;  // piece -> priority
+    bool use_merges = false;
 };
 
 struct Part {
@@ -53,8 +67,9 @@ int32_t pair_rank(const Encoder* e, const uint8_t* text, const Part& a,
                   const Part& b) {
     std::string key(reinterpret_cast<const char*>(text + a.start),
                     a.len + b.len);
-    auto it = e->ranks.find(key);
-    return it == e->ranks.end() ? -1 : it->second;
+    const auto& table = e->use_merges ? e->merges : e->ranks;
+    auto it = table.find(key);
+    return it == table.end() ? -1 : it->second;
 }
 
 }  // namespace
@@ -69,12 +84,28 @@ void bpe_add_token(void* h, const uint8_t* bytes, int len, int32_t rank) {
                      rank);
 }
 
+void bpe_add_merge(void* h, const uint8_t* bytes, int len, int32_t prio) {
+    auto* e = static_cast<Encoder*>(h);
+    e->merges.emplace(std::string(reinterpret_cast<const char*>(bytes), len),
+                      prio);
+    e->use_merges = true;
+}
+
 void bpe_finalize(void*) {}  // reserved for a future pair index
 
-int bpe_encode(void* h, const uint8_t* text, int len, int32_t* out,
-               int out_cap) {
+int bpe_encode_bounded(void* h, const uint8_t* text, int len,
+                       const int32_t* bounds, int nbounds, int32_t* out,
+                       int out_cap) {
     auto* e = static_cast<Encoder*>(h);
     if (len <= 0) return 0;
+
+    // piece boundaries: a merge may never bridge two pre-tokenizer
+    // pieces — any pair whose right side STARTS a piece is forbidden
+    std::vector<uint8_t> boundary(len, 0);
+    for (int i = 0; i < nbounds; ++i) {
+        int32_t b = bounds[i];
+        if (b > 0 && b < len) boundary[b] = 1;
+    }
 
     std::vector<Part> parts(len);
     for (int i = 0; i < len; ++i) {
@@ -85,6 +116,7 @@ int bpe_encode(void* h, const uint8_t* text, int len, int32_t* out,
     std::priority_queue<HeapEntry, std::vector<HeapEntry>,
                         std::greater<HeapEntry>> heap;
     for (int i = 0; i + 1 < len; ++i) {
+        if (boundary[i + 1]) continue;
         int32_t r = pair_rank(e, text, parts[i], parts[i + 1]);
         if (r >= 0) heap.push({r, i, 0, parts[i + 1].start, 0});
     }
@@ -108,13 +140,13 @@ int bpe_encode(void* h, const uint8_t* text, int len, int32_t* out,
         a.next = b.next;
         if (b.next >= 0) parts[b.next].prev = top.left;
 
-        if (a.prev >= 0) {
+        if (a.prev >= 0 && !boundary[a.start]) {
             Part& p = parts[a.prev];
             int32_t pr = pair_rank(e, text, p, a);
             if (pr >= 0)
                 heap.push({pr, a.prev, p.version, a.start, a.version});
         }
-        if (a.next >= 0) {
+        if (a.next >= 0 && !boundary[parts[a.next].start]) {
             Part& n = parts[a.next];
             int32_t nr = pair_rank(e, text, a, n);
             if (nr >= 0)
@@ -144,6 +176,11 @@ int bpe_encode(void* h, const uint8_t* text, int len, int32_t* out,
         }
     }
     return n;
+}
+
+int bpe_encode(void* h, const uint8_t* text, int len, int32_t* out,
+               int out_cap) {
+    return bpe_encode_bounded(h, text, len, nullptr, 0, out, out_cap);
 }
 
 void bpe_destroy(void* h) { delete static_cast<Encoder*>(h); }

@@ -115,12 +115,11 @@ class BPETokenizer:
         for text, sid in self.specials.items():
             self._decode_table.setdefault(sid, text.encode())
         self._native = None
-        if merge_ranks is None:  # native fast path assumes id == rank
-            try:
-                from ..native import bpe as native_bpe
-                self._native = native_bpe.load(ranks)
-            except Exception:
-                self._native = None
+        try:
+            from ..native import bpe as native_bpe
+            self._native = native_bpe.load(ranks, merge_ranks)
+        except Exception:
+            self._native = None
 
     @classmethod
     def from_files(cls, ranks_path: str | Path,
@@ -201,9 +200,27 @@ class BPETokenizer:
 
     def encode(self, text: str, *, bos: bool = True) -> list[int]:
         if self._pretok is not None:
-            ids: list[int] = []
-            for piece in self._pretok.findall(text):
-                ids.extend(self._bpe_merge(piece.encode("utf-8")))
+            str_pieces = self._pretok.findall(text)
+            pieces = [p.encode("utf-8") for p in str_pieces]
+            # the pattern tiles any input, but guard anyway (by char
+            # count — findall returns ordered substrings, so full
+            # coverage implies equality): a gap would make whole-text
+            # native encoding see bytes the per-piece fallback drops
+            if self._native is not None \
+                    and sum(map(len, str_pieces)) == len(text):
+                # ONE GIL-released native call for the whole text:
+                # piece boundaries ride along as byte offsets merges
+                # may not cross
+                bounds: list[int] = []
+                off = 0
+                for piece in pieces:
+                    bounds.append(off)
+                    off += len(piece)
+                ids = self._native.encode(b"".join(pieces), bounds)
+            else:
+                ids = []
+                for piece in pieces:
+                    ids.extend(self._bpe_merge(piece))
         elif self._native is not None:
             ids = self._native.encode(text.encode("utf-8"))
         else:

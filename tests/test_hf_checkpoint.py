@@ -252,3 +252,36 @@ def test_hf_tokenizer_roundtrips_unicode(tmp_path):
     tok = BPETokenizer.from_hf_json(_mini_tokenizer_json(tmp_path))
     text = "héllo wörld ☃"
     assert tok.decode(tok.encode(text, bos=False)) == text
+
+
+def test_hf_tokenizer_native_matches_python(tmp_path):
+    """The C++ fast path (merge table + piece boundaries in one
+    bounded call) must produce exactly the pure-Python per-piece
+    ids."""
+    tok = BPETokenizer.from_hf_json(_mini_tokenizer_json(tmp_path))
+    if tok._native is None:
+        pytest.skip("no C++ toolchain")
+    texts = ["the cat", "the the the", " the\n\nthe at cat",
+             "a  b   c", "don't", "héllo wörld ☃", "", "   ",
+             "that that", "cat" * 50]
+    tok_py = BPETokenizer(tok.ranks, tok.specials,
+                          merge_ranks=tok.merge_ranks,
+                          pretokenize=True)
+    tok_py._native = None
+    for text in texts:
+        assert tok.encode(text, bos=False) \
+            == tok_py.encode(text, bos=False), text
+
+
+def test_native_boundaries_forbid_cross_piece_merges(tmp_path):
+    """'that' would merge 'at' across ' t|hat'-style splits if
+    boundaries were ignored; the boundary array must pin piece
+    edges."""
+    tok = BPETokenizer.from_hf_json(_mini_tokenizer_json(tmp_path))
+    if tok._native is None:
+        pytest.skip("no C++ toolchain")
+    # "c at" pretokenizes to ["c", " at"]: the 'c'+'a' pair may not
+    # merge into "cat" across the boundary
+    ids = tok.encode("c at", bos=False)
+    assert tok.ranks[b"cat"] not in ids
+    assert tok.decode(ids) == "c at"
