@@ -21,10 +21,23 @@ def build_app(config=None) -> App:
         app.container.add_pubsub(InMemoryBroker(
             logger=app.logger, metrics=app.container.metrics))
 
-    preset = getattr(WhisperConfig,
-                     app.config.get_or_default("MODEL_PRESET", "tiny_test"))
-    model_config = preset()
-    params = whisper_init(jax.random.key(0), model_config)
+    model_path = app.config.get_or_default("MODEL_PATH", "")
+    if model_path:
+        # HF-format Whisper checkpoint (config.json + model.safetensors);
+        # MODEL_DTYPE overrides the serving dtype (default bfloat16 —
+        # set float32 to keep a float32 checkpoint's exact numerics)
+        import jax.numpy as jnp
+        from gofr_tpu.models.hf_checkpoint import load_whisper_checkpoint
+        dtype_name = app.config.get_or_default("MODEL_DTYPE", "")
+        params, model_config = load_whisper_checkpoint(
+            model_path,
+            dtype=getattr(jnp, dtype_name) if dtype_name else None)
+    else:
+        preset = getattr(
+            WhisperConfig,
+            app.config.get_or_default("MODEL_PRESET", "tiny_test"))
+        model_config = preset()
+        params = whisper_init(jax.random.key(0), model_config)
     transcriber = Transcriber(params, model_config,
                               ASRConfig(max_batch=4, max_tokens=16,
                                         sample_buckets=(16000, 80000)))

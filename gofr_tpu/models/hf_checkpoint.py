@@ -189,6 +189,25 @@ def _resolve_weight_files(directory: Path) -> dict[str, Path]:
         f"{directory}")
 
 
+def _tensor_reader(directory: Path):
+    """name -> memmap-backed array across the checkpoint's files,
+    with files opened lazily and shared by both model loaders."""
+    files = _resolve_weight_files(directory)
+    opened: dict[Path, dict[str, np.ndarray]] = {}
+
+    def tensor(name: str) -> np.ndarray:
+        try:
+            path = files[name]
+        except KeyError:
+            raise KeyError(f"checkpoint is missing tensor {name!r}") \
+                from None
+        if path not in opened:
+            opened[path] = read_safetensors(path)
+        return opened[path][name]
+
+    return tensor
+
+
 def load_llama_checkpoint(directory: str | Path, *,
                           dtype: Any = None,
                           quantize: str | None = None,
@@ -215,18 +234,7 @@ def load_llama_checkpoint(directory: str | Path, *,
     if dtype is not None:
         config = config.scaled(dtype=dtype)
 
-    files = _resolve_weight_files(directory)
-    opened: dict[Path, dict[str, np.ndarray]] = {}
-
-    def tensor(name: str) -> np.ndarray:
-        try:
-            path = files[name]
-        except KeyError:
-            raise KeyError(f"checkpoint is missing tensor {name!r}") \
-                from None
-        if path not in opened:
-            opened[path] = read_safetensors(path)
-        return opened[path][name]
+    tensor = _tensor_reader(directory)
 
     if quantize not in (None, "int8"):
         raise ValueError(f"quantize must be None or 'int8', "
@@ -274,6 +282,180 @@ def load_llama_checkpoint(directory: str | Path, *,
         params["lm_head"] = to(tensor("lm_head.weight"), transpose=True,
                                quant_axis=0)
     return params, config
+
+
+# ---------------------------------------------------------- whisper map
+#
+# HF "WhisperForConditionalGeneration" layout. Conv1d stores
+# [out_channels, in_channels, kernel]; this repo's encoder convs are
+# [kernel, in, out] (models/whisper.py:144) — axes reverse on the way
+# through. Attention/MLP linears transpose like llama's. k_proj has no
+# bias in every Whisper size; proj_out ties to the token embedding.
+
+_WHISPER_BLOCK = (
+    ("ln1_w", "self_attn_layer_norm.weight", False),
+    ("ln1_b", "self_attn_layer_norm.bias", False),
+    ("wq", "self_attn.q_proj.weight", True),
+    ("bq", "self_attn.q_proj.bias", False),
+    ("wk", "self_attn.k_proj.weight", True),
+    ("wv", "self_attn.v_proj.weight", True),
+    ("bv", "self_attn.v_proj.bias", False),
+    ("wo", "self_attn.out_proj.weight", True),
+    ("bo", "self_attn.out_proj.bias", False),
+    ("ln_mlp_w", "final_layer_norm.weight", False),
+    ("ln_mlp_b", "final_layer_norm.bias", False),
+    ("fc1", "fc1.weight", True),
+    ("fc1_b", "fc1.bias", False),
+    ("fc2", "fc2.weight", True),
+    ("fc2_b", "fc2.bias", False),
+)
+_WHISPER_CROSS = (
+    ("lnx_w", "encoder_attn_layer_norm.weight", False),
+    ("lnx_b", "encoder_attn_layer_norm.bias", False),
+    ("xwq", "encoder_attn.q_proj.weight", True),
+    ("xbq", "encoder_attn.q_proj.bias", False),
+    ("xwk", "encoder_attn.k_proj.weight", True),
+    ("xwv", "encoder_attn.v_proj.weight", True),
+    ("xbv", "encoder_attn.v_proj.bias", False),
+    ("xwo", "encoder_attn.out_proj.weight", True),
+    ("xbo", "encoder_attn.out_proj.bias", False),
+)
+
+
+def whisper_config_from_hf(cfg: dict) -> "Any":
+    from .whisper import WhisperConfig
+    return WhisperConfig(
+        vocab_size=cfg["vocab_size"],
+        n_mels=cfg.get("num_mel_bins", 80),
+        dim=cfg["d_model"],
+        n_heads=cfg.get("encoder_attention_heads", 8),
+        n_audio_layers=cfg["encoder_layers"],
+        n_text_layers=cfg["decoder_layers"],
+        audio_ctx=cfg.get("max_source_positions", 1500),
+        audio_frames=2 * cfg.get("max_source_positions", 1500),
+        text_ctx=cfg.get("max_target_positions", 448),
+        sot_token=cfg.get("decoder_start_token_id", 50258),
+        eot_token=cfg.get("eos_token_id", 50257),
+    )
+
+
+def whisper_config_to_hf(c: "Any") -> dict:
+    return {
+        "architectures": ["WhisperForConditionalGeneration"],
+        "model_type": "whisper",
+        "vocab_size": c.vocab_size,
+        "num_mel_bins": c.n_mels,
+        "d_model": c.dim,
+        "encoder_attention_heads": c.n_heads,
+        "decoder_attention_heads": c.n_heads,
+        "encoder_layers": c.n_audio_layers,
+        "decoder_layers": c.n_text_layers,
+        "max_source_positions": c.audio_ctx,
+        "max_target_positions": c.text_ctx,
+        "decoder_start_token_id": c.sot_token,
+        "eos_token_id": c.eot_token,
+    }
+
+
+def load_whisper_checkpoint(directory: str | Path, *,
+                            dtype: Any = None) -> tuple[dict, "Any"]:
+    """Load an HF-format Whisper checkpoint directory into
+    ``(params, WhisperConfig)`` for ``models/whisper.py``'s
+    transcription stack (the BASELINE Whisper-ASR config's
+    real-weight path)."""
+    import jax.numpy as jnp
+
+    directory = Path(directory)
+    config = whisper_config_from_hf(
+        json.loads((directory / "config.json").read_text()))
+    if dtype is not None:
+        config = config.scaled(dtype=dtype)
+    c = config
+    target = np.dtype(c.dtype)
+    tensor = _tensor_reader(directory)
+
+    def to(name: str, transpose: bool = False) -> Any:
+        a = np.asarray(tensor(name)).astype(target, copy=False)
+        return jnp.asarray(a.T if transpose else a)
+
+    def conv(name: str) -> Any:  # HF [out, in, k] -> ours [k, in, out]
+        a = np.asarray(tensor(name)).astype(target, copy=False)
+        return jnp.asarray(a.transpose(2, 1, 0))
+
+    def stack(side: str, n_layers: int, entries) -> dict:
+        out: dict = {}
+        for key, suffix, transpose in entries:
+            rows = [np.asarray(
+                tensor(f"model.{side}.layers.{i}.{suffix}"))
+                .astype(target, copy=False) for i in range(n_layers)]
+            if transpose:
+                rows = [r.T for r in rows]
+            out[key] = jnp.asarray(np.stack(rows))
+        return out
+
+    params = {
+        "conv1_w": conv("model.encoder.conv1.weight"),
+        "conv1_b": to("model.encoder.conv1.bias"),
+        "conv2_w": conv("model.encoder.conv2.weight"),
+        "conv2_b": to("model.encoder.conv2.bias"),
+        "enc_pos": to("model.encoder.embed_positions.weight"),
+        "enc_layers": stack("encoder", c.n_audio_layers, _WHISPER_BLOCK),
+        "enc_ln_w": to("model.encoder.layer_norm.weight"),
+        "enc_ln_b": to("model.encoder.layer_norm.bias"),
+        "embed": to("model.decoder.embed_tokens.weight"),
+        "dec_pos": to("model.decoder.embed_positions.weight"),
+        "dec_layers": stack("decoder", c.n_text_layers,
+                            _WHISPER_BLOCK + _WHISPER_CROSS),
+        "dec_ln_w": to("model.decoder.layer_norm.weight"),
+        "dec_ln_b": to("model.decoder.layer_norm.bias"),
+    }
+    return params, config
+
+
+def save_whisper_checkpoint(params: dict, config: "Any",
+                            directory: str | Path) -> None:
+    """Inverse of ``load_whisper_checkpoint`` (and its CI fixture
+    generator)."""
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    (directory / "config.json").write_text(
+        json.dumps(whisper_config_to_hf(config), indent=1))
+    bf16 = _bf16()
+
+    def host(a: Any) -> np.ndarray:
+        a = np.asarray(a)
+        if a.dtype not in (np.float32, np.float16, bf16):
+            a = a.astype(np.float32)
+        return a
+
+    tensors: dict[str, np.ndarray] = {
+        "model.encoder.conv1.weight":
+            host(params["conv1_w"]).transpose(2, 1, 0),
+        "model.encoder.conv1.bias": host(params["conv1_b"]),
+        "model.encoder.conv2.weight":
+            host(params["conv2_w"]).transpose(2, 1, 0),
+        "model.encoder.conv2.bias": host(params["conv2_b"]),
+        "model.encoder.embed_positions.weight": host(params["enc_pos"]),
+        "model.encoder.layer_norm.weight": host(params["enc_ln_w"]),
+        "model.encoder.layer_norm.bias": host(params["enc_ln_b"]),
+        "model.decoder.embed_tokens.weight": host(params["embed"]),
+        "model.decoder.embed_positions.weight": host(params["dec_pos"]),
+        "model.decoder.layer_norm.weight": host(params["dec_ln_w"]),
+        "model.decoder.layer_norm.bias": host(params["dec_ln_b"]),
+    }
+    for side, n_layers, entries in (
+            ("encoder", config.n_audio_layers, _WHISPER_BLOCK),
+            ("decoder", config.n_text_layers,
+             _WHISPER_BLOCK + _WHISPER_CROSS)):
+        for key, suffix, transpose in entries:
+            stacked = params[f"{'enc' if side == 'encoder' else 'dec'}"
+                             f"_layers"][key]
+            for i in range(n_layers):
+                a = host(stacked[i])
+                tensors[f"model.{side}.layers.{i}.{suffix}"] = \
+                    a.T if transpose else a
+    write_safetensors(directory / "model.safetensors", tensors,
+                      metadata={"format": "pt"})
 
 
 def save_llama_checkpoint(params: dict, config: LlamaConfig,

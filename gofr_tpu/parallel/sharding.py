@@ -74,7 +74,17 @@ def moe_param_specs(mesh: Mesh) -> dict:
 
 def _match_specs(params: Any, specs: Any) -> Any:
     """Prune spec tree to the keys present in params (tied embeddings
-    drop lm_head)."""
+    drop lm_head), descending into weight-only-quantized ``{'q','s'}``
+    leaves: the int8 matrix keeps the matrix spec, and the per-output-
+    channel scales inherit it with the collapsed (size-1) reduction
+    axis unsharded — so int8 serving shards exactly like bf16."""
+    from ..ops.quant import is_quantized
+    if is_quantized(params) and not isinstance(specs, dict):
+        scale = params["s"]
+        s_spec = P(*(None if scale.shape[i] == 1
+                     else (specs[i] if i < len(specs) else None)
+                     for i in range(scale.ndim)))
+        return {"q": specs, "s": s_spec}
     if isinstance(params, dict):
         return {k: _match_specs(v, specs[k]) for k, v in params.items()}
     return specs

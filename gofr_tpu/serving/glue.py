@@ -46,14 +46,11 @@ def llama_engine(params: Any, model_config: LlamaConfig,
         if quantize != "int8":
             raise ValueError(f"quantize must be None or 'int8', "
                              f"got {quantize!r}")
-        if mesh is not None:
-            raise ValueError(
-                "quantize + mesh sharding is not supported yet: the "
-                "sharding specs do not descend into quantized {'q','s'} "
-                "leaves — serve quantized single-chip, or sharded bf16")
         # weight-only int8: halves HBM param streaming in the
         # memory-bound decode (ops/quant.py); the model functions
-        # detect quantized leaves per-matrix
+        # detect quantized leaves per-matrix, and the sharding specs
+        # descend into the {'q','s'} leaves (parallel/sharding.py
+        # _match_specs), so int8 composes with mesh serving
         from ..ops.quant import quantize_llama_int8
         params = quantize_llama_int8(params)
 

@@ -411,3 +411,27 @@ def test_asr_worker():
         assert status == 201
         import json
         assert "tokens" in json.loads(data)["data"]
+
+
+def test_asr_worker_from_disk_checkpoint(tmp_path):
+    """MODEL_PATH: the ASR worker transcribes with weights loaded
+    from an on-disk HF-format Whisper checkpoint."""
+    import json
+
+    import jax
+    import numpy as np
+
+    from gofr_tpu.models.hf_checkpoint import save_whisper_checkpoint
+    from gofr_tpu.models.whisper import WhisperConfig, whisper_init
+
+    cfg_w = WhisperConfig.tiny_test()
+    save_whisper_checkpoint(whisper_init(jax.random.key(2), cfg_w),
+                            cfg_w, tmp_path)
+    mod = load_example("asr-worker")
+    app = mod.build_app(cfg(MODEL_PATH=str(tmp_path)))
+    with AppRunner(app=app) as runner:
+        tone = np.sin(np.linspace(0, 440, 4000)).astype(np.float32)
+        status, _, data = runner.request("POST", "/transcribe",
+                                         {"audio": tone.tolist()})
+        assert status == 201
+        assert "tokens" in json.loads(data)["data"]

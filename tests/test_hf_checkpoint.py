@@ -188,6 +188,72 @@ def test_loaded_checkpoint_serves(tiny_checkpoint):
         engine.stop()
 
 
+# -------------------------------------------------------------- whisper
+
+def test_whisper_checkpoint_roundtrip(tmp_path):
+    """Save a tiny Whisper as HF format, load it back, transcribe —
+    params exact, greedy transcription identical (the ASR flagship's
+    real-weight path)."""
+    from gofr_tpu.models.hf_checkpoint import (
+        load_whisper_checkpoint,
+        save_whisper_checkpoint,
+    )
+    from gofr_tpu.models.whisper import (
+        WhisperConfig,
+        transcribe_audio,
+        whisper_init,
+    )
+
+    cfg = WhisperConfig.tiny_test()
+    params = whisper_init(jax.random.key(5), cfg)
+    save_whisper_checkpoint(params, cfg, tmp_path)
+
+    # the on-disk layout is HF's: conv [out, in, k], linears [out, in]
+    tensors = read_safetensors(tmp_path / "model.safetensors")
+    assert tensors["model.encoder.conv1.weight"].shape == \
+        (cfg.dim, cfg.n_mels, 3)
+    assert tensors["model.decoder.layers.0.fc1.weight"].shape == \
+        (4 * cfg.dim, cfg.dim)
+    assert "model.decoder.layers.0.encoder_attn.q_proj.weight" in tensors
+    assert "model.encoder.layers.0.encoder_attn.q_proj.weight" \
+        not in tensors  # cross-attention is decoder-only
+
+    loaded, lcfg = load_whisper_checkpoint(tmp_path, dtype=jnp.float32)
+    assert lcfg.dim == cfg.dim and lcfg.n_mels == cfg.n_mels
+    flat_want = dict(jax.tree.leaves_with_path(params))
+    flat_got = dict(jax.tree.leaves_with_path(loaded))
+    assert set(flat_want) == set(flat_got)
+    for path, want in flat_want.items():
+        np.testing.assert_array_equal(
+            np.asarray(flat_got[path]), np.asarray(want),
+            err_msg=str(path))
+
+    audio = np.sin(np.linspace(0, 55, 1600)).astype(np.float32)[None]
+    want_toks, want_lens = transcribe_audio(
+        params, jnp.asarray(audio), cfg, max_tokens=8)
+    got_toks, got_lens = transcribe_audio(
+        loaded, jnp.asarray(audio), lcfg, max_tokens=8)
+    assert np.array_equal(np.asarray(want_toks), np.asarray(got_toks))
+    assert np.array_equal(np.asarray(want_lens), np.asarray(got_lens))
+
+
+def test_whisper_missing_tensor_is_clear(tmp_path):
+    from gofr_tpu.models.hf_checkpoint import (
+        load_whisper_checkpoint,
+        save_whisper_checkpoint,
+    )
+    from gofr_tpu.models.whisper import WhisperConfig, whisper_init
+
+    cfg = WhisperConfig.tiny_test()
+    save_whisper_checkpoint(whisper_init(jax.random.key(1), cfg), cfg,
+                            tmp_path)
+    tensors = dict(read_safetensors(tmp_path / "model.safetensors"))
+    tensors.pop("model.decoder.layers.1.encoder_attn.v_proj.bias")
+    write_safetensors(tmp_path / "model.safetensors", tensors)
+    with pytest.raises(KeyError, match="encoder_attn.v_proj.bias"):
+        load_whisper_checkpoint(tmp_path)
+
+
 # ------------------------------------------------------- tokenizer.json
 
 def _mini_tokenizer_json(tmp_path):

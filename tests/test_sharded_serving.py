@@ -148,6 +148,54 @@ def _generate_modern(mesh):
         eng.stop()
 
 
+def _generate_int8(mesh):
+    params = llama_init(jax.random.key(0), TINY)
+    eng = llama_engine(params, TINY,
+                       EngineConfig(max_batch=4, max_seq=128, seed=11),
+                       mesh=mesh, implementation="xla",
+                       quantize="int8")
+    eng.start()
+    try:
+        reqs = [eng.submit([3 + i, 1, 4, 1, 5],
+                           SamplingParams(temperature=0.0,
+                                          max_new_tokens=8))
+                for i in range(4)]
+        deadline = time.time() + 120
+        while time.time() < deadline and any(
+                r.finished_at is None and r.error is None for r in reqs):
+            time.sleep(0.01)
+        assert all(r.error is None for r in reqs)
+        return [r.generated for r in reqs]
+    finally:
+        eng.stop()
+
+
+def test_int8_sharded_matches_int8_single_device():
+    """Weight-only int8 composes with tp sharding: the {'q','s'}
+    leaves shard like their bf16 matrix (scales keep the output axis,
+    reduction axis unsharded) and greedy outputs are identical to
+    single-device int8."""
+    single = _generate_int8(None)
+    sharded = _generate_int8(create_mesh({"tp": 2}, jax.devices()[:2]))
+    assert sharded == single
+
+
+def test_int8_sharded_params_actually_sharded():
+    mesh = create_mesh({"tp": 2}, jax.devices()[:2])
+    params = llama_init(jax.random.key(0), TINY)
+    eng = llama_engine(params, TINY,
+                       EngineConfig(max_batch=2, max_seq=64),
+                       mesh=mesh, implementation="xla", quantize="int8")
+    wq = eng.params["layers"]["wq"]
+    out_dim = TINY.n_heads * TINY.head_dim
+    assert {s.data.shape for s in wq["q"].addressable_shards} == \
+        {(TINY.n_layers, TINY.dim, out_dim // 2)}
+    # scales: per-output-channel, sharded with the output axis
+    assert {s.data.shape for s in wq["s"].addressable_shards} == \
+        {(TINY.n_layers, 1, out_dim // 2)}
+    # engine never started: nothing to stop
+
+
 def test_modern_engine_sharded_matches_single_device():
     """Greedy equivalence for the full modern feature set — paged KV,
     prefix cache, chunked prefill, speculative decode, pipelining —
