@@ -26,12 +26,12 @@ def build_app(config=None) -> App:
         # HF-format Whisper checkpoint (config.json + model.safetensors);
         # MODEL_DTYPE overrides the serving dtype (default bfloat16 —
         # set float32 to keep a float32 checkpoint's exact numerics)
-        import jax.numpy as jnp
-        from gofr_tpu.models.hf_checkpoint import load_whisper_checkpoint
+        from gofr_tpu.models.hf_checkpoint import (load_whisper_checkpoint,
+                                                   resolve_serving_dtype)
         dtype_name = app.config.get_or_default("MODEL_DTYPE", "")
         params, model_config = load_whisper_checkpoint(
             model_path,
-            dtype=getattr(jnp, dtype_name) if dtype_name else None)
+            dtype=resolve_serving_dtype(dtype_name) if dtype_name else None)
     else:
         preset = getattr(
             WhisperConfig,

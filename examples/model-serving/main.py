@@ -30,12 +30,13 @@ def build_app(config=None) -> App:
     if model_path:
         from pathlib import Path
 
-        from gofr_tpu.models.hf_checkpoint import load_llama_checkpoint
+        from gofr_tpu.models.hf_checkpoint import (load_llama_checkpoint,
+                                                   resolve_serving_dtype)
         max_seq = int(app.config.get_or_default("MODEL_MAX_SEQ", "8192"))
         dtype_name = app.config.get_or_default("MODEL_DTYPE", "")
         params, model_config = load_llama_checkpoint(
             model_path, quantize=quant, max_seq=max_seq,
-            dtype=getattr(jax.numpy, dtype_name) if dtype_name else None)
+            dtype=resolve_serving_dtype(dtype_name) if dtype_name else None)
         quant = None  # already applied on load
         model_name = Path(model_path).name
         tok_json = Path(model_path) / "tokenizer.json"

@@ -136,6 +136,27 @@ _LAYER_MAP = (
 )
 
 
+_SERVING_DTYPES = {"float32": "float32", "fp32": "float32",
+                   "bfloat16": "bfloat16", "bf16": "bfloat16",
+                   "float16": "float16", "fp16": "float16"}
+
+
+def resolve_serving_dtype(name: str):
+    """Map a user-facing dtype name (``MODEL_DTYPE``) to a jnp float
+    dtype, accepting the common short spellings. Rejects everything
+    else up front: ``getattr(jnp, name)`` would happily resolve
+    ``int8`` (which is NOT quantization — that's ``MODEL_QUANT``) and
+    serve garbage with no error."""
+    import jax.numpy as jnp
+    canon = _SERVING_DTYPES.get(name.strip().lower())
+    if canon is None:
+        raise ValueError(
+            f"MODEL_DTYPE={name!r}: expected one of "
+            f"{sorted(set(_SERVING_DTYPES))} (for int8 weight-only "
+            f"quantization use MODEL_QUANT=int8)")
+    return getattr(jnp, canon)
+
+
 def llama_config_from_hf(cfg: dict) -> LlamaConfig:
     """config.json -> LlamaConfig (HF "LlamaForCausalLM" schema)."""
     return LlamaConfig(
@@ -324,11 +345,20 @@ _WHISPER_CROSS = (
 
 def whisper_config_from_hf(cfg: dict) -> "Any":
     from .whisper import WhisperConfig
+    enc_heads = cfg.get("encoder_attention_heads", 8)
+    dec_heads = cfg.get("decoder_attention_heads", enc_heads)
+    if dec_heads != enc_heads:
+        # the in-repo WhisperConfig models one head count (true for
+        # every released Whisper size); a checkpoint that differs
+        # would reshape q/k/v wrong and transcribe garbage silently
+        raise ValueError(
+            f"unsupported Whisper config: encoder_attention_heads="
+            f"{enc_heads} != decoder_attention_heads={dec_heads}")
     return WhisperConfig(
         vocab_size=cfg["vocab_size"],
         n_mels=cfg.get("num_mel_bins", 80),
         dim=cfg["d_model"],
-        n_heads=cfg.get("encoder_attention_heads", 8),
+        n_heads=enc_heads,
         n_audio_layers=cfg["encoder_layers"],
         n_text_layers=cfg["decoder_layers"],
         audio_ctx=cfg.get("max_source_positions", 1500),

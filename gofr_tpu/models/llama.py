@@ -274,16 +274,17 @@ def llama_decode_step_paged(params: dict, tokens: jnp.ndarray,
     traffic per pass), this writes each new K/V row through the block
     table and attends with the ragged paged kernel
     (:func:`..ops.paged_attention.paged_decode_attention`), so the pool
-    is only ever touched in place. pools [L, Np, pg, Hkv, hd]; tables
-    [B, Mp]; lengths [B] = rows already cached (the new token lands at
-    that position). Returns (logits [B, V], new_k_pool, new_v_pool).
+    is only ever touched in place. pools [L, Hkv, Np, pg, hd]
+    (head-major — see ops/paged_kv.py); tables [B, Mp]; lengths [B] =
+    rows already cached (the new token lands at that position).
+    Returns (logits [B, V], new_k_pool, new_v_pool).
     """
     from ..ops.paged_attention import paged_decode_attention
     c = config
     b = tokens.shape[0]
     hd = c.head_dim
-    pg = k_pool.shape[2]
-    n_pages = k_pool.shape[1]
+    pg = k_pool.shape[3]
+    n_pages = k_pool.shape[2]
     inv_freq = rope_frequencies(c.head_dim, c.rope_theta, c.rope_scaling)
     positions = lengths[:, None]
     x = qgather(params["embed"], tokens, c.dtype)[:, None, :]  # [B, 1, D]
@@ -296,15 +297,17 @@ def llama_decode_step_paged(params: dict, tokens: jnp.ndarray,
     offs = lengths % pg
 
     def layer_fn(x, scanned):
-        lp, kp, vp = scanned          # [Np, pg, Hkv, hd]
+        lp, kp, vp = scanned          # [Hkv, Np, pg, hd]
         h = rms_norm(x, lp["attn_norm"], c.norm_eps)
         q = qmatmul(h, lp["wq"]).reshape(b, 1, c.n_heads, hd)
         k = qmatmul(h, lp["wk"]).reshape(b, 1, c.n_kv_heads, hd)
         v = qmatmul(h, lp["wv"]).reshape(b, 1, c.n_kv_heads, hd)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
-        kp = kp.at[pids, offs].set(k[:, 0].astype(kp.dtype), mode="drop")
-        vp = vp.at[pids, offs].set(v[:, 0].astype(vp.dtype), mode="drop")
+        k_rows = k[:, 0].transpose(1, 0, 2).astype(kp.dtype)  # [Hkv, B, hd]
+        v_rows = v[:, 0].transpose(1, 0, 2).astype(vp.dtype)
+        kp = kp.at[:, pids, offs].set(k_rows, mode="drop")
+        vp = vp.at[:, pids, offs].set(v_rows, mode="drop")
         out = paged_decode_attention(q[:, 0], kp, vp, tables, lengths + 1,
                                      implementation=implementation)
         x = x + qmatmul(out.reshape(b, 1, c.n_heads * hd), lp["wo"])

@@ -1,7 +1,7 @@
 """Ragged paged decode-attention — pages read in place via block table.
 
-The paged KV layout (:mod:`.paged_kv`) stores K/V in a page pool
-``[Np, pg, Hkv, hd]`` per layer with per-slot block tables. The
+The paged KV layout (:mod:`.paged_kv`) stores K/V in a HEAD-MAJOR page
+pool ``[Hkv, Np, pg, hd]`` per layer with per-slot block tables. The
 generic engine path materialises a dense per-slot view of the WHOLE
 pool allocation every K-step pass (``gather_view``), which costs
 O(full-cache) extra HBM traffic on top of attention's own reads —
@@ -13,9 +13,17 @@ shorter slots read fewer pages), DMA-ing pages HBM→VMEM double-buffered
 and folding them into an online-softmax accumulator. The pool is never
 reshaped, copied, or padded to the per-slot maximum.
 
+Head-major matters on real hardware: Mosaic tiles the trailing two
+dims of a memref, so slicing a TRAILING head axis to 1 per grid cell
+(the r4 ``[Np, pg, Hkv, hd]`` layout) is illegal ("Slice shape along
+dimension 2 must be aligned to tiling (8), but is 1" — first real-TPU
+compile, r5), while ``pool.at[h, pid]`` slices only untiled leading
+dims AND makes each page read a contiguous [pg, hd] block instead of a
+strided one.
+
 Layouts (decode, Sq == 1):
 - ``q``        [B, Hq, hd]
-- ``k_pool``   [Np, pg, Hkv, hd] (one layer's pool; bf16 in serving)
+- ``k_pool``   [Hkv, Np, pg, hd] (one layer's pool; bf16 in serving)
 - ``tables``   [B, Mp] int32 — page ids, out-of-range = unallocated
 - ``lengths``  [B] int32 — valid rows per slot (AFTER this step's write)
 - out          [B, Hq, hd]
@@ -58,8 +66,8 @@ def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_hbm, v_hbm,
 
     def start_chunk(ci, slot):
         # one DMA per page: pages are scattered in the pool, so a
-        # chunk is pages_per_chunk independent strided copies (the
-        # kv-head slice of each page)
+        # chunk is pages_per_chunk independent copies — each a
+        # CONTIGUOUS [page, hd] block in the head-major pool
         for j in range(pages_per_chunk):
             # tail chunks index past the table: clamp — their rows are
             # masked off by `length` below, they just must not fault
@@ -67,11 +75,11 @@ def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_hbm, v_hbm,
                                    max_pages - 1)
             pid = jnp.minimum(tables_ref[b, page_idx], n_pages - 1)
             pltpu.make_async_copy(
-                k_hbm.at[pid, :, h, :],
+                k_hbm.at[h, pid],
                 k_buf.at[slot, pl.ds(j * page, page), :],
                 sems.at[slot, 0, j]).start()
             pltpu.make_async_copy(
-                v_hbm.at[pid, :, h, :],
+                v_hbm.at[h, pid],
                 v_buf.at[slot, pl.ds(j * page, page), :],
                 sems.at[slot, 1, j]).start()
 
@@ -81,11 +89,11 @@ def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_hbm, v_hbm,
                                    max_pages - 1)
             pid = jnp.minimum(tables_ref[b, page_idx], n_pages - 1)
             pltpu.make_async_copy(
-                k_hbm.at[pid, :, h, :],
+                k_hbm.at[h, pid],
                 k_buf.at[slot, pl.ds(j * page, page), :],
                 sems.at[slot, 0, j]).wait()
             pltpu.make_async_copy(
-                v_hbm.at[pid, :, h, :],
+                v_hbm.at[h, pid],
                 v_buf.at[slot, pl.ds(j * page, page), :],
                 sems.at[slot, 1, j]).wait()
 
@@ -136,9 +144,9 @@ def paged_decode_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
                                   lengths: jnp.ndarray, *,
                                   scale: float | None = None,
                                   interpret: bool = False) -> jnp.ndarray:
-    """The Pallas path. q [B, Hq, hd] -> [B, Hq, hd]."""
+    """The Pallas path. q [B, Hq, hd], pools [Hkv, Np, pg, hd]."""
     b, hq, hd = q.shape
-    n_pages, page, hkv, _ = k_pool.shape
+    hkv, n_pages, page, _ = k_pool.shape
     _, max_pages = tables.shape
     group = hq // hkv
     scale = scale if scale is not None else hd ** -0.5
@@ -192,11 +200,13 @@ def paged_decode_attention_xla(q: jnp.ndarray, k_pool: jnp.ndarray,
     """Reference path: gather the slot views, run dense masked decode
     attention. Correct everywhere; materialises [B, Mp*pg, Hkv, hd]."""
     from .attention import decode_attention
-    n_pages, page, hkv, hd = k_pool.shape
+    hkv, n_pages, page, hd = k_pool.shape
     b, max_pages = tables.shape
     safe = jnp.minimum(tables, n_pages - 1)
-    k_view = k_pool[safe].reshape(b, max_pages * page, hkv, hd)
-    v_view = v_pool[safe].reshape(b, max_pages * page, hkv, hd)
+    k_view = k_pool[:, safe].transpose(1, 2, 3, 0, 4).reshape(
+        b, max_pages * page, hkv, hd)
+    v_view = v_pool[:, safe].transpose(1, 2, 3, 0, 4).reshape(
+        b, max_pages * page, hkv, hd)
     return decode_attention(q[:, None], k_view, v_view, lengths,
                             scale=scale)[:, 0]
 

@@ -1,12 +1,21 @@
 """Paged KV cache primitives — block-table indirection over a page pool.
 
 The serving engine's paged layout (vLLM-style, re-designed for XLA's
-static-shape world): K/V live in a pool ``[L, n_pages, page, Hkv, hd]``
-and each slot owns an ordered list of page ids (its *block table*,
-shape ``[max_pages]``). Capacity is decoupled from ``max_batch x
-max_seq``: slots allocate pages as they grow and free them on retire,
-so many long-tailed requests overcommit a pool that a contiguous
-per-slot layout could never fit.
+static-shape world): K/V live in a HEAD-MAJOR pool
+``[L, Hkv, n_pages, page, hd]`` and each slot owns an ordered list of
+page ids (its *block table*, shape ``[max_pages]``). Capacity is
+decoupled from ``max_batch x max_seq``: slots allocate pages as they
+grow and free them on retire, so many long-tailed requests overcommit
+a pool that a contiguous per-slot layout could never fit.
+
+Head-major (kv-head axis OUTSIDE the page grid) is the TPU-native
+choice: the ragged paged-attention kernel's per-(head, page) DMA is a
+contiguous ``[page, hd]`` block — Mosaic requires slices of the tiled
+trailing dims to be tile-aligned, so a trailing head axis (the r4
+layout) cannot be sliced per-grid-cell at all, and head-major also
+makes every page read stride-free. The Mosaic error this fixes:
+"Slice shape along dimension 2 must be aligned to tiling (8), but is
+1" (scripts/tpu_results/02_pallas_smoke.py.json, r5).
 
 Everything here is a pure jittable function on static shapes:
 
@@ -30,14 +39,15 @@ import jax.numpy as jnp
 
 
 def gather_view(pool: jnp.ndarray, tables: jnp.ndarray) -> jnp.ndarray:
-    """Pool [L, Np, pg, H, d] + tables [B, Mp] -> view [L, B, Mp*pg, H, d].
+    """Pool [L, H, Np, pg, d] + tables [B, Mp] -> view [L, B, Mp*pg, H, d].
 
     Out-of-range table entries (unallocated = Np) clamp to the last
     page on gather; those rows are masked by the caller's kv_lengths.
     """
-    l, np_, pg, h, d = pool.shape
+    l, h, np_, pg, d = pool.shape
     b, mp = tables.shape
-    view = pool[:, tables]                      # [L, B, Mp, pg, H, d]
+    view = pool[:, :, tables]                   # [L, H, B, Mp, pg, d]
+    view = view.transpose(0, 2, 3, 4, 1, 5)     # [L, B, Mp, pg, H, d]
     return view.reshape(l, b, mp * pg, h, d)
 
 
@@ -47,12 +57,13 @@ def scatter_prefill(pool: jnp.ndarray, tables: jnp.ndarray,
     per-row tables [P, Mp]. Positions whose table entry is the OOB page
     id are dropped (padding beyond each row's allocation, dummy rows).
     """
-    pg = pool.shape[2]
+    pg = pool.shape[3]
     s = k_slab.shape[2]
     pos = jnp.arange(s)
     pids = jnp.take(tables, pos // pg, axis=1)          # [P, S]
     offs = jnp.broadcast_to(pos % pg, pids.shape)       # [P, S]
-    return pool.at[:, pids, offs].set(k_slab, mode="drop")
+    slab = k_slab.transpose(0, 3, 1, 2, 4)              # [L, H, P, S, d]
+    return pool.at[:, :, pids, offs].set(slab, mode="drop")
 
 
 def scatter_decode(pool: jnp.ndarray, tables: jnp.ndarray,
@@ -62,8 +73,8 @@ def scatter_decode(pool: jnp.ndarray, tables: jnp.ndarray,
     (at logical positions lengths .. lengths+K-1 per slot) back into
     the pool. view [L, B, S, H, d], tables [B, Mp], lengths [B].
     """
-    pg = pool.shape[2]
-    n_pages = pool.shape[1]
+    pg = pool.shape[3]
+    n_pages = pool.shape[2]
     s = view.shape[2]
     positions = lengths[:, None] + jnp.arange(k_steps)[None, :]   # [B, K]
     clamped = jnp.minimum(positions, s - 1)
@@ -74,4 +85,13 @@ def scatter_decode(pool: jnp.ndarray, tables: jnp.ndarray,
     # taking a partial pass) must drop, not overwrite the last row
     pids = jnp.where(positions < s, pids, n_pages)
     offs = clamped % pg
-    return pool.at[:, pids, offs].set(new_rows, mode="drop")
+    rows = new_rows.transpose(0, 3, 1, 2, 4)            # [L, H, B, K, d]
+    return pool.at[:, :, pids, offs].set(rows, mode="drop")
+
+
+def pool_from_cache_shape(k_cache: jnp.ndarray) -> jnp.ndarray:
+    """Re-lay a dense [L, Np, pg, H, d] allocation (what
+    ``make_cache(n_pages, page)`` returns) as the head-major pool
+    [L, H, Np, pg, d]. Zero-cost on zeros; used by the engine so model
+    glue only needs one cache constructor."""
+    return k_cache.transpose(0, 3, 1, 2, 4)

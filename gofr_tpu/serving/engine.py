@@ -361,9 +361,7 @@ class Engine:
             self._pages_per_slot = -(-cfg.max_seq // pg)        # ceil
             self._n_pages = (cfg.kv_pages if cfg.kv_pages is not None
                              else cfg.max_batch * self._pages_per_slot)
-            # make_cache(batch, seq) -> [L, batch, seq, Hkv, hd]; calling
-            # it as (n_pages, page) yields exactly the pool layout
-            self.k_cache, self.v_cache = make_cache(self._n_pages, pg)
+            self.k_cache, self.v_cache = self._alloc_pool(pg)
             self._free_pages = list(range(self._n_pages))
             #: per-slot ordered page ids; OOB id ``n_pages`` = unallocated
             self._tables = np.full((cfg.max_batch, self._pages_per_slot),
@@ -1133,6 +1131,28 @@ class Engine:
             self._requeued_set.add(id(req))
             self._requeued.append(req)
 
+    def _alloc_pool(self, page: int):
+        """Allocate the head-major paged pool [L, Hkv, Np, pg, hd]
+        (ops/paged_kv.py: the kernel's per-(head, page) DMA must slice
+        only untiled leading dims). Cache constructors that know the
+        layout build it directly (``head_major=True``); older ones
+        return [L, Np, pg, Hkv, hd] and pay a one-off transpose."""
+        import inspect
+
+        from ..ops.paged_kv import pool_from_cache_shape
+        try:
+            aware = "head_major" in inspect.signature(
+                self._make_cache).parameters
+        except (TypeError, ValueError):  # builtins/partials: no sig
+            aware = False
+        if aware:
+            # signature-probed, NOT try/except TypeError: an error
+            # raised INSIDE an aware constructor must surface as
+            # itself, not silently re-run the legacy path
+            return self._make_cache(self._n_pages, page, head_major=True)
+        kc, vc = self._make_cache(self._n_pages, page)
+        return pool_from_cache_shape(kc), pool_from_cache_shape(vc)
+
     def _recover_lost_cache(self, exc: BaseException) -> None:
         """A failed prefill may have consumed the donated caches; if
         so every active slot's KV went with them — fail those streams
@@ -1148,8 +1168,8 @@ class Engine:
                                   f"{exc}")
         self.lengths[:] = 0
         if cfg.kv_layout == "paged":  # same geometry, pristine allocator
-            self.k_cache, self.v_cache = self._make_cache(
-                self._n_pages, cfg.page_size)
+            self.k_cache, self.v_cache = self._alloc_pool(
+                max(1, int(cfg.page_size)))
             self._free_pages = list(range(self._n_pages))
             self._tables[:] = self._n_pages
             self._slot_pages[:] = 0

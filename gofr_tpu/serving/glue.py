@@ -103,11 +103,25 @@ def llama_engine(params: Any, model_config: LlamaConfig,
             kc, vc = constrain_kv(kc), constrain_kv(vc)
         return logits, kc, vc
 
-    def make_cache(batch, max_seq):
-        kc, vc = make_empty_cache(c, batch, max_seq=max_seq)
+    def make_cache(batch, max_seq, head_major=False):
+        if head_major:
+            # paged pool [L, Hkv, Np, pg, hd] (ops/paged_kv.py),
+            # allocated directly — no transient double buffer
+            import jax.numpy as jnp
+            shape = (c.n_layers, c.n_kv_heads, batch, max_seq,
+                     c.head_dim)
+            kc = jnp.zeros(shape, c.dtype)
+            vc = jnp.zeros(shape, c.dtype)
+        else:
+            kc, vc = make_empty_cache(c, batch, max_seq=max_seq)
         if mesh is not None:
             import jax
-            sharding = _kv_sharding(mesh)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            if head_major:
+                tp = "tp" if "tp" in mesh.axis_names else None
+                sharding = NamedSharding(mesh, P(None, tp))
+            else:
+                sharding = _kv_sharding(mesh)
             kc = jax.device_put(kc, sharding)
             vc = jax.device_put(vc, sharding)
         return kc, vc
@@ -154,8 +168,10 @@ def moe_engine(params: Any, model_config, engine_config: EngineConfig | None = N
     def decode_fn(params, tokens, k_cache, v_cache, lengths):
         return moe_decode_step(params, tokens, k_cache, v_cache, lengths, c)
 
-    def make_cache(batch, max_seq):
-        shape = (c.n_layers, batch, max_seq, c.n_kv_heads, c.head_dim)
+    def make_cache(batch, max_seq, head_major=False):
+        shape = ((c.n_layers, c.n_kv_heads, batch, max_seq, c.head_dim)
+                 if head_major else
+                 (c.n_layers, batch, max_seq, c.n_kv_heads, c.head_dim))
         return jnp.zeros(shape, c.dtype), jnp.zeros(shape, c.dtype)
 
     return Engine(params, engine_config, prefill_fn=prefill_fn,

@@ -70,8 +70,9 @@ from gofr_tpu.ops.paged_attention import (paged_decode_attention_pallas,
 
 NP_, PG, MP = (16, 16, 4) if SMOKE else (512, 64, 16)
 B2 = 2 if SMOKE else 16
-kp = jax.random.normal(ks[0], (NP_, PG, HKV, D), dtype)
-vp = jax.random.normal(ks[1], (NP_, PG, HKV, D), dtype)
+# head-major pool [Hkv, Np, pg, hd] (ops/paged_kv.py r5 re-layout)
+kp = jax.random.normal(ks[0], (HKV, NP_, PG, D), dtype)
+vp = jax.random.normal(ks[1], (HKV, NP_, PG, D), dtype)
 q2 = jax.random.normal(ks[2], (B2, HQ, D), dtype)
 rng = np.random.default_rng(0)
 tables = np.full((B2, MP), NP_, np.int32)

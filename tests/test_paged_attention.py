@@ -17,8 +17,9 @@ def _random_paged_case(key, *, b=3, hq=4, hkv=2, hd=16, page=8,
     """Build a pool + tables + the equivalent dense cache."""
     ks = jax.random.split(key, 3)
     q = jax.random.normal(ks[0], (b, hq, hd), jnp.float32)
-    k_pool = jax.random.normal(ks[1], (n_pages, page, hkv, hd), jnp.float32)
-    v_pool = jax.random.normal(ks[2], (n_pages, page, hkv, hd), jnp.float32)
+    # head-major pool [Hkv, Np, pg, hd] (ops/paged_kv.py)
+    k_pool = jax.random.normal(ks[1], (hkv, n_pages, page, hd), jnp.float32)
+    v_pool = jax.random.normal(ks[2], (hkv, n_pages, page, hd), jnp.float32)
     rng = np.random.default_rng(0)
     tables = np.full((b, max_pages), n_pages, np.int32)  # OOB = unalloc
     for i, ln in enumerate(lengths):
@@ -28,8 +29,10 @@ def _random_paged_case(key, *, b=3, hq=4, hkv=2, hd=16, page=8,
     lengths = jnp.asarray(list(lengths), jnp.int32)
     # dense equivalent: gather allocated pages (OOB clamps, rows masked)
     safe = jnp.minimum(tables, n_pages - 1)
-    k_dense = k_pool[safe].reshape(b, max_pages * page, hkv, hd)
-    v_dense = v_pool[safe].reshape(b, max_pages * page, hkv, hd)
+    k_dense = k_pool[:, safe].transpose(1, 2, 3, 0, 4).reshape(
+        b, max_pages * page, hkv, hd)
+    v_dense = v_pool[:, safe].transpose(1, 2, 3, 0, 4).reshape(
+        b, max_pages * page, hkv, hd)
     return q, k_pool, v_pool, tables, lengths, k_dense, v_dense
 
 
@@ -60,9 +63,9 @@ def test_ragged_lengths_ignore_unallocated_tail():
     # poison every page NOT referenced by the first ceil(9/8)=2 entries
     used = set(np.asarray(tables)[:, :2].ravel().tolist())
     poison = np.asarray(k_pool).copy()
-    for p in range(poison.shape[0]):
+    for p in range(poison.shape[1]):
         if p not in used:
-            poison[p] = 1e6
+            poison[:, p] = 1e6
     got_clean = paged_decode_attention_pallas(
         q, k_pool, v_pool, tables, lengths, interpret=True)
     got_poisoned = paged_decode_attention_pallas(

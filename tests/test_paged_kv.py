@@ -13,7 +13,7 @@ L, NP, PG, H, D = 2, 6, 4, 2, 3   # layers, pages, page size, heads, head dim
 
 
 def _pool(fill=0.0):
-    return jnp.full((L, NP, PG, H, D), fill, jnp.float32)
+    return jnp.full((L, H, NP, PG, D), fill, jnp.float32)
 
 
 def test_scatter_prefill_then_gather_roundtrip():
@@ -34,10 +34,10 @@ def test_scatter_prefill_drops_unallocated_padding():
     slab = jnp.ones((L, 1, 8, H, D), jnp.float32)           # rows 4..7 OOB
     pool = scatter_prefill(pool, tables, slab)
     got = np.asarray(pool)
-    assert (got[:, 1] == 1.0).all()                         # page 1 written
+    assert (got[:, :, 1] == 1.0).all()                         # page 1 written
     mask = np.ones(NP, bool)
     mask[1] = False
-    assert (got[:, mask] == -1.0).all()                     # others untouched
+    assert (got[:, :, mask] == -1.0).all()                     # others untouched
 
 
 def test_scatter_prefill_dummy_row_dropped():
@@ -57,8 +57,8 @@ def test_scatter_decode_writes_k_rows():
     view = view.at[:, 0, 4].set(8.0)
     pool = scatter_decode(pool, tables, view, jnp.asarray([3]), 2)
     got = np.asarray(pool)
-    assert (got[:, 3, 3] == 7.0).all()   # logical 3 -> page 3, offset 3
-    assert (got[:, 1, 0] == 8.0).all()   # logical 4 -> page 1, offset 0
+    assert (got[:, :, 3, 3] == 7.0).all()   # logical 3 -> page 3, offset 3
+    assert (got[:, :, 1, 0] == 8.0).all()   # logical 4 -> page 1, offset 0
     assert got.sum() == (7.0 + 8.0) * L * H * D
 
 
@@ -69,9 +69,9 @@ def test_scatter_decode_past_view_end_drops():
     pool = scatter_decode(pool, tables, view, jnp.asarray([11]), 2)
     got = np.asarray(pool)
     # position 11 lands (page 2, offset 3); position 12 is dropped
-    assert (got[:, 2, 3] == 0.0).all()
+    assert (got[:, :, 2, 3] == 0.0).all()
     untouched = np.full_like(got, -1.0)
-    untouched[:, 2, 3] = 0.0
+    untouched[:, :, 2, 3] = 0.0
     np.testing.assert_array_equal(got, untouched)
 
 
@@ -162,3 +162,26 @@ def test_paged_greedy_unaffected_by_preemption():
     tight.stop()
     assert all(r.error is None for r in got)
     assert all(r.generated == want for r in got)
+
+
+def test_recovered_pool_keeps_head_major_layout():
+    """_recover_lost_cache must rebuild the pool in the SAME head-major
+    [L, Hkv, Np, pg, hd] layout the init path allocates (a recovery
+    that reverts to the dense-cache axis order silently corrupts every
+    subsequent scatter/gather)."""
+    eng = demo_llama_engine(EngineConfig(
+        max_batch=2, max_seq=64, seed=5, kv_layout="paged", page_size=16))
+    shape_before = eng.k_cache.shape
+    eng.k_cache.delete()
+    eng.v_cache.delete()
+    eng._recover_lost_cache(RuntimeError("induced"))
+    assert eng.k_cache.shape == shape_before
+    assert eng.v_cache.shape == shape_before
+    # and the engine still serves after recovery
+    eng.start()
+    reqs = [eng.submit([3, 1, 4], SamplingParams(
+        temperature=0.0, max_new_tokens=6)) for _ in range(2)]
+    _drain(reqs)
+    eng.stop()
+    assert all(r.error is None for r in reqs), [r.error for r in reqs]
+    assert all(len(r.generated) == 6 for r in reqs)
