@@ -1928,29 +1928,44 @@ def _sample_batch(logits: jnp.ndarray, key: jax.Array,
     ``TOPK_BOUND`` most likely tokens after the row's top-k filter and
     a top-p filter applied *on the top-k-renormalised* distribution
     (``top_ks`` row value 0 disables top-k for that row).
+
+    All-greedy batches (the common serving case and every benchmark)
+    take a ``lax.cond`` fast path: a plain argmax, skipping the
+    vocab-wide ``lax.top_k`` whose cost scales with B x V and is pure
+    waste when no row samples. The predicate is traced, so one compile
+    covers both regimes.
     """
     logits = logits.astype(jnp.float32)
     bound = min(TOPK_BOUND, logits.shape[-1])
 
-    safe_t = jnp.maximum(temperatures, 1e-6)[:, None]
-    vals, idx = jax.lax.top_k(logits / safe_t, bound)  # sorted descending
+    def _greedy(_):
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-    # top-k first: mask candidates beyond each row's k (0 = disabled)
-    pos = jnp.arange(bound)[None, :]
-    if top_ks is not None:
-        k_eff = jnp.where(top_ks > 0, jnp.minimum(top_ks, bound), bound)
-        vals = jnp.where(pos < k_eff[:, None], vals, NEG_INF)
+    def _full(_):
+        safe_t = jnp.maximum(temperatures, 1e-6)[:, None]
+        vals, idx = jax.lax.top_k(logits / safe_t, bound)  # sorted desc
 
-    # then top-p on the renormalised survivor distribution
-    probs = jax.nn.softmax(vals, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    keep = jnp.roll(cum, 1, axis=-1) < top_ps[:, None]
-    keep = keep.at[..., 0].set(True)
-    filtered = jnp.where(keep, vals, NEG_INF)
+        # top-k first: mask candidates beyond each row's k (0 = disabled)
+        pos = jnp.arange(bound)[None, :]
+        if top_ks is not None:
+            k_eff = jnp.where(top_ks > 0, jnp.minimum(top_ks, bound),
+                              bound)
+            vals = jnp.where(pos < k_eff[:, None], vals, NEG_INF)
 
-    gumbel = -jnp.log(-jnp.log(
-        jax.random.uniform(key, vals.shape, minval=1e-20, maxval=1.0) + 1e-20))
-    choice = jnp.argmax(filtered + gumbel, axis=-1)
-    sampled = jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0]
-    # temperature scaling is monotonic, so idx[:, 0] IS the argmax
-    return jnp.where(temperatures <= 0.0, idx[:, 0], sampled).astype(jnp.int32)
+        # then top-p on the renormalised survivor distribution
+        probs = jax.nn.softmax(vals, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = jnp.roll(cum, 1, axis=-1) < top_ps[:, None]
+        keep = keep.at[..., 0].set(True)
+        filtered = jnp.where(keep, vals, NEG_INF)
+
+        gumbel = -jnp.log(-jnp.log(
+            jax.random.uniform(key, vals.shape, minval=1e-20,
+                               maxval=1.0) + 1e-20))
+        choice = jnp.argmax(filtered + gumbel, axis=-1)
+        sampled = jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0]
+        # temperature scaling is monotonic, so idx[:, 0] IS the argmax
+        return jnp.where(temperatures <= 0.0, idx[:, 0],
+                         sampled).astype(jnp.int32)
+
+    return jax.lax.cond(jnp.all(temperatures <= 0.0), _greedy, _full, None)

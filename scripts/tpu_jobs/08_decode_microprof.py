@@ -63,6 +63,22 @@ t = timed(bw_fn, big)
 stream_bytes = big.size * 2
 out["hbm_stream_gbps"] = round(stream_bytes / t / 1e9, 1)
 
+def timed_donated(step_fn, kc, vc, reps=REPS):
+    """Median wall of a donated-cache decode step: the caches thread
+    through each call (donation invalidates the previous buffers), so
+    the generic timed() helper cannot be used."""
+    logits, kc, vc = step_fn(params, tokens, kc, vc, lengths)
+    jax.block_until_ready(logits)
+    walls = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        logits, kc, vc = step_fn(params, tokens, kc, vc, lengths)
+        jax.block_until_ready(logits)
+        walls.append(time.perf_counter() - t0)
+    walls.sort()
+    return walls[len(walls) // 2]
+
+
 # ---- 2) bare decode step (one token, no scan, no sampling)
 kc, vc = make_empty_cache(c, B)
 lengths = jnp.full((B,), 64 if not SMOKE else 8, jnp.int32)
@@ -70,24 +86,7 @@ tokens = jnp.full((B,), 5, jnp.int32)
 
 step = jax.jit(lambda p, t_, k, v, l: llama_decode_step(p, t_, k, v, l, c),
                donate_argnums=(2, 3))
-kc2, vc2 = kc, vc
-
-
-def one_step(p, t_, k, v, l):
-    logits, k, v = step(p, t_, k, v, l)
-    return logits, k, v
-
-
-logits, kc2, vc2 = one_step(params, tokens, kc2, vc2, lengths)
-jax.block_until_ready(logits)
-walls = []
-for _ in range(REPS):
-    t0 = time.perf_counter()
-    logits, kc2, vc2 = one_step(params, tokens, kc2, vc2, lengths)
-    jax.block_until_ready(logits)
-    walls.append(time.perf_counter() - t0)
-walls.sort()
-t_step = walls[len(walls) // 2]
+t_step = timed_donated(step, kc, vc)
 out["bare_step_ms"] = round(t_step * 1e3, 2)
 out["bare_step_tok_per_s"] = round(B / t_step, 1)
 out["bare_step_pct_roofline"] = round(
@@ -137,9 +136,33 @@ else:
     t_head = timed(head_fn, x, head_w)
 out["head_matmul_ms"] = round(t_head * 1e3, 2)
 
-# ---- 5) sampling: greedy argmax over [B, V] logits
+# ---- 5) sampling: all-greedy batches take _sample_batch's lax.cond
+# argmax fast path; one sampled row forces the vocab-wide top_k branch
+from gofr_tpu.serving.engine import _sample_batch
+
 lg = jnp.ones((B, c.vocab_size), jnp.float32)
 argmax_fn = jax.jit(lambda l: jnp.argmax(l, axis=-1))
 out["argmax_ms"] = round(timed(argmax_fn, lg) * 1e3, 2)
+topk_fn = jax.jit(lambda l: jax.lax.top_k(l, 64)[1])
+out["topk64_ms"] = round(timed(topk_fn, lg) * 1e3, 2)
+tps = jnp.ones((B,), jnp.float32)
+tks = jnp.zeros((B,), jnp.int32)
+greedy_t = jnp.zeros((B,), jnp.float32)
+mixed_t = greedy_t.at[0].set(0.7)
+samp_fn = jax.jit(lambda l, k, t: _sample_batch(l, k, t, tps, tks))
+out["sample_greedy_ms"] = round(
+    timed(samp_fn, lg, jax.random.key(0), greedy_t) * 1e3, 2)
+out["sample_mixed_ms"] = round(
+    timed(samp_fn, lg, jax.random.key(0), mixed_t) * 1e3, 2)
+
+# ---- 6) padded-attention share: same step against a short cache
+if not SMOKE:
+    c_short = LlamaConfig.llama3_1b().scaled(max_seq=256)
+    kc_s, vc_s = make_empty_cache(c_short, B)
+    step_s = jax.jit(
+        lambda p, t_, k, v, l: llama_decode_step(p, t_, k, v, l, c_short),
+        donate_argnums=(2, 3))
+    out["bare_step_seq256_ms"] = round(
+        timed_donated(step_s, kc_s, vc_s) * 1e3, 2)
 
 print(json.dumps(out))
