@@ -120,6 +120,10 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                                    lambda bi, hi, qi, lens: (bi, hi, qi, 0)),
         ),
         out_shape=jax.ShapeDtypeStruct((b, hq, sq_p, d), q.dtype),
+        # (batch, head, q-block) cells carry no cross-iteration state —
+        # the online-softmax accumulator lives within one cell's k loop
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel")),
         interpret=interpret,
     )(kv_lengths, qt, kt, vt)
 

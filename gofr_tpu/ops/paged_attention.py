@@ -185,6 +185,11 @@ def paged_decode_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
         kernel,
         out_shape=jax.ShapeDtypeStruct((b, hkv, group, hd), q.dtype),
         grid_spec=grid_spec,
+        # grid cells (slot, kv-head) are independent: declaring them
+        # parallel lets Mosaic software-pipeline across cells instead
+        # of fencing between iterations
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
         interpret=interpret,
     )(tables.astype(jnp.int32), lengths.astype(jnp.int32),
       q4, k_pool, v_pool)

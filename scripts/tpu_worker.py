@@ -174,13 +174,22 @@ def main() -> None:
         jobs = sorted(f for f in os.listdir(QUEUE) if f.endswith(".py"))
         drained = False
         if jobs and _probe() is not None:
-            # tunnel healthy right now — drain as much as we can while
-            # it lasts; each job re-checks implicitly by failing fast
-            for name in jobs:
+            # tunnel healthy right now — drain while it lasts, but
+            # re-probe between jobs: a mid-drain tunnel death must not
+            # burn a full init-timeout per remaining queued job
+            # (observed r5: jobs 05/06/07 each waited ~25 min against
+            # a dead backend after 04 outlived the tunnel)
+            for i, name in enumerate(jobs):
                 path = os.path.join(QUEUE, name)
-                if os.path.exists(path):
-                    _run_job(path)
-                    drained = True
+                if not os.path.exists(path):
+                    continue
+                if i > 0 and _probe() is None:
+                    _log({"event": "drain_abort",
+                          "why": "tunnel died mid-drain"})
+                    drained = False  # back off (PROBE_INTERVAL_S), the
+                    break            # tunnel was just observed dead
+                _run_job(path)
+                drained = True
         # only hurry when the tunnel just proved healthy; a failed
         # probe already burned PROBE_TIMEOUT_S — don't hammer it
         time.sleep(30 if drained else PROBE_INTERVAL_S)
