@@ -144,6 +144,9 @@ run_point(32, 8, "paged", paged_attention="kernel")
 # weight-only int8: half the HBM param traffic — the decode-roofline
 # lever (ops/quant.py)
 run_point(32, 8, "slot", quantize="int8")
+# the best-known composition: ragged kernel reads only live KV rows,
+# int8 halves the weight stream
+run_point(32, 8, "paged", paged_attention="kernel", quantize="int8")
 
 print("RESULT_JSON " + json.dumps({
     "job": "engine_sweep", "device": DEV, "n_params": n_params,

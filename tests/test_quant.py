@@ -109,3 +109,38 @@ def test_engine_quantize_rejects_unknown():
     with pytest.raises(ValueError, match="int8"):
         llama_engine(params, config, EngineConfig(max_batch=2),
                      quantize="fp4")
+
+
+def test_int8_composes_with_native_paged_kernel():
+    """int8 weights + the native paged decode path (row writes through
+    the block table, ragged kernel in interpret mode) must match the
+    int8 slot-layout engine greedily — protects the best-known TPU
+    serving composition (paged kernel + int8)."""
+    import time
+
+    from gofr_tpu.serving.engine import EngineConfig, SamplingParams
+    from gofr_tpu.serving.glue import llama_engine
+
+    config = LlamaConfig.tiny()
+    params = llama_init(jax.random.key(11), config)
+
+    def run(**extra):
+        eng = llama_engine(params, config,
+                           EngineConfig(max_batch=2, max_seq=128, seed=9,
+                                        **extra),
+                           implementation="xla", quantize="int8")
+        eng.start()
+        reqs = [eng.submit([5 + i, 2, 9], SamplingParams(
+            temperature=0.0, max_new_tokens=8)) for i in range(2)]
+        deadline = time.time() + 120
+        while time.time() < deadline and any(
+                r.finished_at is None and r.error is None for r in reqs):
+            time.sleep(0.01)
+        eng.stop()
+        assert all(r.error is None for r in reqs), [r.error for r in reqs]
+        return [r.generated for r in reqs]
+
+    want = run()
+    got = run(kv_layout="paged", page_size=16,
+              paged_attention="interpret")
+    assert got == want
