@@ -150,21 +150,27 @@ def moe_decode_step(params: dict, tokens: jnp.ndarray,
     x = params["embed"][tokens][:, None, :]
     batch_idx = jnp.arange(b)
 
-    def layer_fn(x, scanned):
-        lp, kc, vc = scanned
+    # caches ride the scan carry — ys emission would copy each layer's
+    # full [B, Smax, Hkv, hd] slice per step (see llama_decode_step)
+    def layer_fn(carry, scanned):
+        x, kc_all, vc_all = carry
+        lp, li = scanned
         h = rms_norm(x, lp["attn_norm"], c.norm_eps)
         q = (h @ lp["wq"]).reshape(b, 1, c.n_heads, hd)
         k = (h @ lp["wk"]).reshape(b, 1, c.n_kv_heads, hd)
         v = (h @ lp["wv"]).reshape(b, 1, c.n_kv_heads, hd)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
-        kc = kc.at[batch_idx, lengths].set(k[:, 0])
-        vc = vc.at[batch_idx, lengths].set(v[:, 0])
+        kc_all = kc_all.at[li, batch_idx, lengths].set(k[:, 0])
+        vc_all = vc_all.at[li, batch_idx, lengths].set(v[:, 0])
+        kc = jax.lax.dynamic_index_in_dim(kc_all, li, 0, keepdims=False)
+        vc = jax.lax.dynamic_index_in_dim(vc_all, li, 0, keepdims=False)
         out = decode_attention(q, kc, vc, lengths + 1)
         x = x + (out.reshape(b, 1, c.n_heads * hd) @ lp["wo"])
         mlp_out, _ = _moe_mlp(x, lp, c)
-        return x + mlp_out, (kc, vc)
+        return (x + mlp_out, kc_all, vc_all), None
 
-    x, (new_k, new_v) = jax.lax.scan(
-        layer_fn, x, (params["layers"], k_cache, v_cache))
+    (x, new_k, new_v), _ = jax.lax.scan(
+        layer_fn, (x, k_cache, v_cache),
+        (params["layers"], jnp.arange(c.n_layers)))
     return _logits(params, c, x)[:, 0], new_k, new_v

@@ -320,17 +320,26 @@ def _decoder_step_kv(params, tok, pos, cross_k, cross_v, c,
         + params["dec_pos"][pos][None, None, :].astype(c.dtype)
 
     lp = params["dec_layers"]
+    b = tok.shape[0]
+    batch_idx = jnp.arange(b)
 
-    def scan_body(h, xs):
-        layer, xk, xv, kc, vc = xs
+    # caches ride the scan carry with a row scatter — the previous
+    # formulation emitted them as scan ys after a full-cache
+    # jnp.where select, i.e. a whole-cache read+write per layer per
+    # step (see llama_decode_step for the measured cost)
+    def scan_body(carry, xs):
+        h, kc_all, vc_all = carry
+        layer, xk, xv, li = xs
         a = layer_norm(h, layer["ln1_w"], layer["ln1_b"])
         q = _heads(a @ layer["wq"] + layer["bq"], c.n_heads)
         k = _heads(a @ layer["wk"], c.n_heads)
         v = _heads(a @ layer["wv"] + layer["bv"], c.n_heads)
-        rows = jnp.arange(kc.shape[1])[None, :]
-        write = (rows == lengths[:, None])[:, :, None, None]
-        kc = jnp.where(write, k.astype(kc.dtype), kc)
-        vc = jnp.where(write, v.astype(vc.dtype), vc)
+        kc_all = kc_all.at[li, batch_idx, lengths].set(
+            k[:, 0].astype(kc_all.dtype))
+        vc_all = vc_all.at[li, batch_idx, lengths].set(
+            v[:, 0].astype(vc_all.dtype))
+        kc = jax.lax.dynamic_index_in_dim(kc_all, li, 0, keepdims=False)
+        vc = jax.lax.dynamic_index_in_dim(vc_all, li, 0, keepdims=False)
         attn = decode_attention(q, kc, vc, lengths + 1)
         h = h + (_merge(attn) @ layer["wo"] + layer["bo"])
 
@@ -342,13 +351,14 @@ def _decoder_step_kv(params, tok, pos, cross_k, cross_v, c,
         m = layer_norm(h, layer["ln_mlp_w"], layer["ln_mlp_b"])
         h = h + (jax.nn.gelu(m @ layer["fc1"] + layer["fc1_b"])
                  @ layer["fc2"] + layer["fc2_b"])
-        return h, (kc, vc)
+        return (h, kc_all, vc_all), None
 
-    hidden, new_caches = jax.lax.scan(
-        scan_body, x, (lp, cross_k, cross_v, cache_k, cache_v))
+    (hidden, new_k, new_v), _ = jax.lax.scan(
+        scan_body, (x, cache_k, cache_v),
+        (lp, cross_k, cross_v, jnp.arange(c.n_text_layers)))
     hidden = layer_norm(hidden, params["dec_ln_w"], params["dec_ln_b"])
     logits = _logits(params, hidden[:, -1], c)
-    return logits, new_caches[0], new_caches[1]
+    return logits, new_k, new_v
 
 
 def _transcribe_loop(params, c, b, first_tok, done0, cache_k, cache_v,

@@ -138,6 +138,7 @@ def test_int8_composes_with_native_paged_kernel():
             time.sleep(0.01)
         eng.stop()
         assert all(r.error is None for r in reqs), [r.error for r in reqs]
+        assert all(len(r.generated) == 8 for r in reqs)  # really finished
         return [r.generated for r in reqs]
 
     want = run()
