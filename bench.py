@@ -129,7 +129,11 @@ def run_scenario(engine_cfg, prompts, gen_len, warm_lens,
 
 
 base_cfg = EngineConfig(max_batch=max_batch, max_seq=model_config.max_seq,
-                        prefill_buckets=(64, 128, 256, 512), seed=0)
+                        prefill_buckets=(64, 128, 256, 512), seed=0,
+                        # prompt 64 + gen 32 keeps every live row under
+                        # 128: windowed decode attention reads O(128)
+                        # rows instead of O(max_seq) per step
+                        decode_windows=(128, 256))
 prompt = list(range(1, prompt_len + 1))
 reqs, wall, stats = run_scenario(base_cfg, [prompt] * n_requests, gen_len,
                                  (prompt_len,))

@@ -113,6 +113,14 @@ class EngineConfig:
     #: bursts of K and admission happens between passes, so large K
     #: trades TTFT/streaming granularity for throughput.
     decode_steps_per_pass: int = 8
+    #: windowed decode attention (slot layout): extra decode-graph
+    #: variants whose attention reads only the first ``window`` cache
+    #: rows. Each pass picks the smallest listed window covering every
+    #: live length + K; none covering -> the full-max_seq graph.
+    #: Attention HBM traffic becomes O(longest live row), not
+    #: O(max_seq) — decisive when max_seq >> typical lengths. Each
+    #: window is one extra compile (warmed in warmup()). () = off.
+    decode_windows: tuple = ()
     #: waiting requests prefilled per device call. The prefill graph is
     #: a fixed [P, bucket] shape (short groups ride with masked dummy
     #: rows, which cost nothing extra — the shapes are static either
@@ -257,20 +265,27 @@ class Engine:
         K = max(1, int(cfg.decode_steps_per_pass))
 
         def _scan_decode(params, tokens, k_view, v_view, lengths,
-                         step, temps, top_ps, top_ks):
+                         step, temps, top_ps, top_ks, window=None):
             # K decode steps in one lax.scan: sampled tokens feed back
             # into the next step on-device; rng derives in-graph from
             # the step counter (no eager random.split per token)
             def one(carry, k):
                 toks, kc, vc, lens = carry
                 key = jax.random.fold_in(decode_key, step * K + k)
-                logits, kc, vc = decode_fn(params, toks, kc, vc, lens)
+                if window is not None:
+                    logits, kc, vc = decode_fn(params, toks, kc, vc,
+                                               lens, attn_window=window)
+                else:
+                    logits, kc, vc = decode_fn(params, toks, kc, vc,
+                                               lens)
                 nxt = _sample_batch(logits, key, temps, top_ps, top_ks)
                 return (nxt, kc, vc, lens + 1), nxt
 
             return jax.lax.scan(
                 one, (tokens, k_view, v_view, lengths), jnp.arange(K))
 
+        self._decode_windows: tuple = ()
+        self._decode_by_window: dict = {}
         if cfg.kv_layout == "paged":
             from ..ops.paged_kv import (gather_view, scatter_decode,
                                         scatter_prefill)
@@ -325,20 +340,41 @@ class Engine:
                     return toks, toks[-1], k_pool, v_pool  # [K, B], [B]
             self._decode = jax.jit(_decode_sample, donate_argnums=(4, 5))
         else:
-            def _decode_sample(params, tokens, use_prev, prev,
-                               k_cache, v_cache, lengths,
-                               step, temps, top_ps, top_ks):
-                # the prev-token select and the last-row slice both
-                # live IN the graph: an eager `where`/`toks[-1]` on
-                # device arrays costs five op-by-op compiles the first
-                # measured pass pays for (observed 137 ms vs the 3 ms
-                # steady-state pass on the tiny CPU config)
-                toks_in = jnp.where(use_prev, prev, tokens)
-                (_, k_cache, v_cache, _), toks = _scan_decode(
-                    params, toks_in, k_cache, v_cache, lengths,
-                    step, temps, top_ps, top_ks)
-                return toks, toks[-1], k_cache, v_cache  # [K, B], [B]
-            self._decode = jax.jit(_decode_sample, donate_argnums=(4, 5))
+            def _make_decode(window=None):
+                def _decode_sample(params, tokens, use_prev, prev,
+                                   k_cache, v_cache, lengths,
+                                   step, temps, top_ps, top_ks):
+                    # the prev-token select and the last-row slice both
+                    # live IN the graph: an eager `where`/`toks[-1]` on
+                    # device arrays costs five op-by-op compiles the
+                    # first measured pass pays for (observed 137 ms vs
+                    # the 3 ms steady-state pass on the tiny CPU config)
+                    toks_in = jnp.where(use_prev, prev, tokens)
+                    (_, k_cache, v_cache, _), toks = _scan_decode(
+                        params, toks_in, k_cache, v_cache, lengths,
+                        step, temps, top_ps, top_ks, window=window)
+                    return toks, toks[-1], k_cache, v_cache
+                return jax.jit(_decode_sample, donate_argnums=(4, 5))
+
+            self._decode = _make_decode()
+            # windowed decode variants (slot layout only): attention
+            # reads O(window) rows instead of O(max_seq) when every
+            # live length fits the bucket. Opt-in via
+            # cfg.decode_windows; each listed window is a separate
+            # compile, warmed in warmup(). Model glue must accept
+            # attn_window (probed by signature, like head_major).
+            import inspect as _inspect
+            try:
+                supports_window = decode_fn is not None and \
+                    "attn_window" in _inspect.signature(
+                        decode_fn).parameters
+            except (TypeError, ValueError):
+                supports_window = False
+            self._decode_windows = tuple(sorted(
+                w for w in (cfg.decode_windows or ())
+                if 0 < w < cfg.max_seq)) if supports_window else ()
+            self._decode_by_window = {
+                w: _make_decode(w) for w in self._decode_windows}
         self._decode_k = K
         self._prefill_base_key = prefill_key
         self._prefill_cache: dict[Any, Callable] = {}
@@ -570,14 +606,17 @@ class Engine:
             b = cfg.max_batch
             tables = (jnp.full((b, self._pages_per_slot), self._n_pages,
                                jnp.int32),) if paged else ()
-            toks, _, self.k_cache, self.v_cache = self._decode(
-                self.params, jnp.zeros(b, jnp.int32),
-                jnp.zeros(b, bool), self._dev_zero,
-                self.k_cache, self.v_cache, *tables,
-                jnp.ones(b, jnp.int32), np.int32(0),
-                jnp.zeros(b, jnp.float32), jnp.ones(b, jnp.float32),
-                jnp.zeros(b, jnp.int32))
-            jax.block_until_ready(toks)
+            variants = [self._decode] + [
+                self._decode_by_window[w] for w in self._decode_windows]
+            for fn in variants:
+                toks, _, self.k_cache, self.v_cache = fn(
+                    self.params, jnp.zeros(b, jnp.int32),
+                    jnp.zeros(b, bool), self._dev_zero,
+                    self.k_cache, self.v_cache, *tables,
+                    jnp.ones(b, jnp.int32), np.int32(0),
+                    jnp.zeros(b, jnp.float32), jnp.ones(b, jnp.float32),
+                    jnp.zeros(b, jnp.int32))
+                jax.block_until_ready(toks)
         if chunked and self._prefill_chunk_fn is not None:
             # compile the chunk-walk graph at every bucket width for
             # both group sizes the walk uses (solo and full wave) —
@@ -1570,8 +1609,19 @@ class Engine:
                 else self._dev_zero)
         self._rng_step += 1
         tables = (jnp.asarray(self._tables),) if paged else ()
+        decode = self._decode
+        if self._decode_windows:
+            # smallest compiled window covering every live row this
+            # pass will touch (len + K); pending-prefill slots carry
+            # the max_seq drop sentinel and decode garbage either way,
+            # so only active slots bound the window
+            needed = int(device_lengths[active_mask].max()) + K
+            for w in self._decode_windows:
+                if needed <= w:
+                    decode = self._decode_by_window[w]
+                    break
         step_tokens, self._dev_last, self.k_cache, self.v_cache = \
-            self._decode(
+            decode(
                 self.params, jnp.asarray(tokens), jnp.asarray(use_prev),
                 prev, self.k_cache, self.v_cache,
                 *tables, jnp.asarray(device_lengths),

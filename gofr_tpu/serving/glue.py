@@ -78,9 +78,11 @@ def llama_engine(params: Any, model_config: LlamaConfig,
             k, v = constrain_kv(k), constrain_kv(v)
         return logits, (k, v)
 
-    def decode_fn(params, tokens, k_cache, v_cache, lengths):
+    def decode_fn(params, tokens, k_cache, v_cache, lengths,
+                  attn_window=None):
         logits, kc, vc = llama_decode_step(params, tokens, k_cache,
-                                           v_cache, lengths, c)
+                                           v_cache, lengths, c,
+                                           attn_window=attn_window)
         if constrain_kv is not None:
             kc, vc = constrain_kv(kc), constrain_kv(vc)
         return logits, kc, vc

@@ -70,7 +70,10 @@ def run_point(max_batch, k_steps, layout, n_requests=None,
         max_batch=max_batch, max_seq=config.max_seq,
         prefill_buckets=(16, 64) if SMOKE else (64, 128, 256, 512),
         seed=0, decode_steps_per_pass=k_steps, kv_layout=layout,
-        page_size=16 if SMOKE else 64, paged_attention=paged_attention)
+        page_size=16 if SMOKE else 64, paged_attention=paged_attention,
+        # prompt+gen stay under 128 rows; windowed attention keeps
+        # slot-layout decode reads O(live rows), not O(max_seq)
+        decode_windows=() if SMOKE else (128, 256))
     engine = llama_engine(params, config, eng_cfg, quantize=quantize)
     sp = SamplingParams(temperature=0.0, max_new_tokens=gen_len)
     prompt = list(range(1, prompt_len + 1))

@@ -351,3 +351,36 @@ def test_stalled_engine_reports_degraded():
     finally:
         release.set()
         engine.stop()
+
+
+def test_decode_windows_match_full_attention():
+    """Windowed decode attention (reads O(window) rows, not O(max_seq))
+    must be greedily identical to the full graph, including prompts
+    whose lengths cross a window boundary mid-generation."""
+    import time as _t
+
+    from gofr_tpu.serving.glue import demo_llama_engine
+
+    def run(**extra):
+        eng = demo_llama_engine(EngineConfig(max_batch=4, max_seq=256,
+                                             seed=13, **extra))
+        eng.start()
+        # 10-token prompt + 40 generated: passes need 18, 26, 34, ...
+        # rows (len + K, K=8) — the 32-window graph runs the early
+        # passes, then selection hands the SAME donated caches to the
+        # 64 graph and finally the full graph as lengths cross each
+        # boundary (the riskiest path: variant switches mid-request)
+        reqs = [eng.submit(list(range(2, 12)), SamplingParams(
+            temperature=0.0, max_new_tokens=40)) for _ in range(3)]
+        deadline = _t.time() + 120
+        while _t.time() < deadline and any(
+                r.finished_at is None and r.error is None for r in reqs):
+            _t.sleep(0.01)
+        eng.stop()
+        assert all(r.error is None for r in reqs), [r.error for r in reqs]
+        assert all(len(r.generated) == 40 for r in reqs)
+        return [r.generated for r in reqs]
+
+    want = run()
+    got = run(decode_windows=(32, 64))
+    assert got == want
