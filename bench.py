@@ -185,7 +185,11 @@ page = 64 if on_accel else 16
 prod_cfg = EngineConfig(max_batch=16, max_seq=model_config.max_seq,
                         prefill_buckets=(64, 128, 256, 512), seed=0,
                         kv_layout="paged", page_size=page,
-                        prefix_cache=True, speculative=True)
+                        prefix_cache=True, speculative=True,
+                        # windows the paged VIEW path's gather (the
+                        # mesh/CPU path); the native kernel path is
+                        # ragged already and ignores them
+                        decode_windows=(256,) if on_accel else (64, 128))
 # shared system prompt spans 3 full pages, so the page-aligned prefix
 # is cacheable and later admissions skip its compute (prefix_hits > 0)
 system = list(range(7, 7 + 3 * page))

@@ -113,13 +113,16 @@ class EngineConfig:
     #: bursts of K and admission happens between passes, so large K
     #: trades TTFT/streaming granularity for throughput.
     decode_steps_per_pass: int = 8
-    #: windowed decode attention (slot layout): extra decode-graph
-    #: variants whose attention reads only the first ``window`` cache
-    #: rows. Each pass picks the smallest listed window covering every
-    #: live length + K; none covering -> the full-max_seq graph.
-    #: Attention HBM traffic becomes O(longest live row), not
-    #: O(max_seq) — decisive when max_seq >> typical lengths. Each
-    #: window is one extra compile (warmed in warmup()). () = off.
+    #: windowed decode attention: extra decode-graph variants that
+    #: touch only the first ``window`` cache rows — attention reads
+    #: for the slot layout, gather/scatter width for the paged VIEW
+    #: path (the mesh-sharded paged path; the single-device ragged
+    #: kernel is already length-bounded and ignores this). Each pass
+    #: picks the smallest listed window covering every live length +
+    #: K; none covering -> the full-max_seq graph. HBM traffic becomes
+    #: O(longest live row), not O(max_seq) — decisive when max_seq >>
+    #: typical lengths. Each window is one extra compile (warmed in
+    #: warmup()). () = off.
     decode_windows: tuple = ()
     #: waiting requests prefilled per device call. The prefill graph is
     #: a fixed [P, bucket] shape (short groups ride with masked dummy
@@ -286,6 +289,8 @@ class Engine:
 
         self._decode_windows: tuple = ()
         self._decode_by_window: dict = {}
+        cfg_windows = tuple(sorted(
+            w for w in (cfg.decode_windows or ()) if 0 < w < cfg.max_seq))
         if cfg.kv_layout == "paged":
             from ..ops.paged_kv import (gather_view, scatter_decode,
                                         scatter_prefill)
@@ -319,26 +324,46 @@ class Engine:
                         one, (toks_in, k_pool, v_pool, lengths),
                         jnp.arange(K))
                     return toks, toks[-1], k_pool, v_pool  # [K, B], [B]
+                self._decode = jax.jit(_decode_sample,
+                                       donate_argnums=(4, 5))
             else:
-                def _decode_sample(params, tokens, use_prev, prev,
-                                   k_pool, v_pool, tables, lengths,
-                                   step, temps, top_ps, top_ks):
-                    # ONE gather per K-step pass builds the
-                    # slot-contiguous view the dense decode step runs
-                    # on; only the K fresh rows scatter back — the
-                    # model family never sees pages
-                    toks_in = jnp.where(use_prev, prev, tokens)
-                    k_view = gather_view(k_pool, tables)
-                    v_view = gather_view(v_pool, tables)
-                    (_, k_view, v_view, _), toks = _scan_decode(
-                        params, toks_in, k_view, v_view, lengths,
-                        step, temps, top_ps, top_ks)
-                    k_pool = scatter_decode(k_pool, tables, k_view,
-                                            lengths, K)
-                    v_pool = scatter_decode(v_pool, tables, v_view,
-                                            lengths, K)
-                    return toks, toks[-1], k_pool, v_pool  # [K, B], [B]
-            self._decode = jax.jit(_decode_sample, donate_argnums=(4, 5))
+                pg_rows = max(1, int(cfg.page_size))
+
+                def _make_decode(window=None):
+                    # windowed variant: gather (and scatter back) only
+                    # the first ceil(window/pg) table columns — the
+                    # materialised view is O(window) rows per slot, not
+                    # O(max_seq). This is the path mesh-sharded paged
+                    # serving runs (the ragged kernel is single-device),
+                    # so the win lands on multi-chip TPU too.
+                    mp_w = (None if window is None
+                            else -(-window // pg_rows))
+
+                    def _decode_sample(params, tokens, use_prev, prev,
+                                       k_pool, v_pool, tables, lengths,
+                                       step, temps, top_ps, top_ks):
+                        # ONE gather per K-step pass builds the
+                        # slot-contiguous view the dense decode step
+                        # runs on; only the K fresh rows scatter back —
+                        # the model family never sees pages
+                        toks_in = jnp.where(use_prev, prev, tokens)
+                        tb = tables if mp_w is None else tables[:, :mp_w]
+                        k_view = gather_view(k_pool, tb)
+                        v_view = gather_view(v_pool, tb)
+                        (_, k_view, v_view, _), toks = _scan_decode(
+                            params, toks_in, k_view, v_view, lengths,
+                            step, temps, top_ps, top_ks)
+                        k_pool = scatter_decode(k_pool, tb, k_view,
+                                                lengths, K)
+                        v_pool = scatter_decode(v_pool, tb, v_view,
+                                                lengths, K)
+                        return toks, toks[-1], k_pool, v_pool
+                    return jax.jit(_decode_sample, donate_argnums=(4, 5))
+
+                self._decode = _make_decode()
+                self._decode_windows = cfg_windows
+                self._decode_by_window = {
+                    w: _make_decode(w) for w in self._decode_windows}
         else:
             def _make_decode(window=None):
                 def _decode_sample(params, tokens, use_prev, prev,
@@ -357,12 +382,12 @@ class Engine:
                 return jax.jit(_decode_sample, donate_argnums=(4, 5))
 
             self._decode = _make_decode()
-            # windowed decode variants (slot layout only): attention
-            # reads O(window) rows instead of O(max_seq) when every
-            # live length fits the bucket. Opt-in via
-            # cfg.decode_windows; each listed window is a separate
-            # compile, warmed in warmup(). Model glue must accept
-            # attn_window (probed by signature, like head_major).
+            # windowed decode variants: attention reads O(window) rows
+            # instead of O(max_seq) when every live length fits the
+            # bucket. Opt-in via cfg.decode_windows; each listed
+            # window is a separate compile, warmed in warmup(). Model
+            # glue must accept attn_window (probed by signature, like
+            # head_major).
             import inspect as _inspect
             try:
                 supports_window = decode_fn is not None and \
@@ -370,9 +395,7 @@ class Engine:
                         decode_fn).parameters
             except (TypeError, ValueError):
                 supports_window = False
-            self._decode_windows = tuple(sorted(
-                w for w in (cfg.decode_windows or ())
-                if 0 < w < cfg.max_seq)) if supports_window else ()
+            self._decode_windows = cfg_windows if supports_window else ()
             self._decode_by_window = {
                 w: _make_decode(w) for w in self._decode_windows}
         self._decode_k = K

@@ -185,3 +185,25 @@ def test_recovered_pool_keeps_head_major_layout():
     eng.stop()
     assert all(r.error is None for r in reqs), [r.error for r in reqs]
     assert all(len(r.generated) == 6 for r in reqs)
+
+
+def test_paged_view_decode_windows_match():
+    """Windowed paged-view decode (gathers only the table columns
+    covering the window) must match the unwindowed paged engine
+    greedily across a window boundary."""
+    def run(**extra):
+        eng = demo_llama_engine(EngineConfig(
+            max_batch=2, max_seq=128, seed=21, kv_layout="paged",
+            page_size=16, **extra))
+        eng.start()
+        reqs = [eng.submit(list(range(2, 12)), SamplingParams(
+            temperature=0.0, max_new_tokens=40)) for _ in range(2)]
+        _drain(reqs)
+        eng.stop()
+        assert all(r.error is None for r in reqs), [r.error for r in reqs]
+        assert all(len(r.generated) == 40 for r in reqs)
+        return [r.generated for r in reqs]
+
+    want = run()
+    got = run(decode_windows=(32, 64))
+    assert got == want
