@@ -488,7 +488,8 @@ class Engine:
         self.stats = {"prefill_calls": 0, "prefill_s": 0.0,
                       "decode_passes": 0, "decode_s": 0.0,
                       "prefix_hits": 0, "spec_passes": 0,
-                      "spec_accepted": 0}
+                      "spec_accepted": 0, "spec_drafted": 0,
+                      "spec_rows": 0}
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> None:
@@ -1850,6 +1851,13 @@ class Engine:
             n_acc = int(accepted[i])
             emitted = proposals.get(i, [])[:n_acc] + [int(bonus[i])]
             self.stats["spec_accepted"] += n_acc
+            # offered drafts this row — the honest acceptance-rate
+            # denominator (spec_passes counts batched passes, so
+            # accepted/passes*draft overstates with G rows per pass);
+            # spec_rows counts row-participations: each emits exactly
+            # one bonus token, the per-row tokens-per-verify base
+            self.stats["spec_drafted"] += len(proposals.get(i, []))
+            self.stats["spec_rows"] += 1
             # rows for the fed tokens were written at offsets..; only
             # the accepted prefix (plus the already-cached last token)
             # counts — rejected rows are overwritten by later passes

@@ -93,13 +93,16 @@ def run(name, suffix=True, **cfg_kw):
         "prefill_calls": stats.get("prefill_calls", 0),
     }
     if out["spec_passes"]:
-        # accepted drafts + the always-emitted bonus token per pass
-        out["tokens_per_spec_pass"] = round(
-            (out["spec_accepted"] + out["spec_passes"])
-            / out["spec_passes"], 2)
+        # per-ROW metrics: spec_passes counts BATCHED passes (G rows
+        # each), so passes-based denominators overstated both numbers
+        # by the rows per pass (the r5 TPU artifact showed 6.33)
+        rows = stats.get("spec_rows", 0)
+        drafted = stats.get("spec_drafted", 0)
+        # accepted drafts + the one bonus token each row-verify emits
+        out["tokens_per_verify"] = round(
+            (out["spec_accepted"] + rows) / rows, 2) if rows else None
         out["acceptance_rate"] = round(
-            out["spec_accepted"]
-            / (out["spec_passes"] * eng_cfg.spec_draft), 3)
+            out["spec_accepted"] / drafted, 3) if drafted else None
     print("POINT " + json.dumps(out), flush=True)
     return out
 
