@@ -167,8 +167,10 @@ def moe_engine(params: Any, model_config, engine_config: EngineConfig | None = N
             implementation=implementation)
         return logits, caches
 
-    def decode_fn(params, tokens, k_cache, v_cache, lengths):
-        return moe_decode_step(params, tokens, k_cache, v_cache, lengths, c)
+    def decode_fn(params, tokens, k_cache, v_cache, lengths,
+                  attn_window=None):
+        return moe_decode_step(params, tokens, k_cache, v_cache,
+                               lengths, c, attn_window=attn_window)
 
     def make_cache(batch, max_seq, head_major=False):
         shape = ((c.n_layers, c.n_kv_heads, batch, max_seq, c.head_dim)

@@ -136,6 +136,19 @@ else:
     t_head = timed(head_fn, x, head_w)
 out["head_matmul_ms"] = round(t_head * 1e3, 2)
 
+# ---- 4b) prefill: [P, 64] last-logit prefill — compute-bound at
+# these shapes (1024 rows -> ~1024 flops/byte, over the MXU ridge),
+# so time here vs the ~13 ms ideal is kernel/layout overhead
+from gofr_tpu.models.llama import llama_prefill_last
+
+for p_rows in ((2,) if SMOKE else (8, 16)):
+    toks = jnp.ones((p_rows, 16 if SMOKE else 64), jnp.int32)
+    lens = jnp.full((p_rows,), toks.shape[1], jnp.int32)
+    pf = jax.jit(lambda pr, t, l: llama_prefill_last(pr, t, c,
+                                                     kv_lengths=l))
+    t_pf = timed(pf, params, toks, lens)
+    out[f"prefill_{p_rows}x{toks.shape[1]}_ms"] = round(t_pf * 1e3, 2)
+
 # ---- 5) sampling: all-greedy batches take _sample_batch's lax.cond
 # argmax fast path; one sampled row forces the vocab-wide top_k branch
 from gofr_tpu.serving.engine import _sample_batch

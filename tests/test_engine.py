@@ -384,3 +384,38 @@ def test_decode_windows_match_full_attention():
     want = run()
     got = run(decode_windows=(32, 64))
     assert got == want
+
+
+def test_moe_decode_windows_match_full_attention():
+    """MoE windowed decode must match the full graph greedily across a
+    window boundary (same contract as the llama test — the signature
+    probe now enables windows for moe_engine too)."""
+    import time as _t
+
+    import jax
+    from gofr_tpu.models.moe import MoEConfig, moe_init
+    from gofr_tpu.serving.glue import moe_engine
+
+    c = MoEConfig.tiny()
+    params = moe_init(jax.random.key(0), c)
+
+    def run(**extra):
+        eng = moe_engine(params, c,
+                         EngineConfig(max_batch=2, max_seq=128, seed=7,
+                                      **extra),
+                         implementation="xla")
+        eng.start()
+        reqs = [eng.submit([4 + i, 2, 9], SamplingParams(
+            temperature=0.0, max_new_tokens=40)) for i in range(2)]
+        deadline = _t.time() + 120
+        while _t.time() < deadline and any(
+                r.finished_at is None and r.error is None for r in reqs):
+            _t.sleep(0.01)
+        eng.stop()
+        assert all(r.error is None for r in reqs), [r.error for r in reqs]
+        assert all(len(r.generated) == 40 for r in reqs)
+        return [r.generated for r in reqs]
+
+    want = run()
+    got = run(decode_windows=(16, 32))
+    assert got == want
