@@ -291,6 +291,10 @@ class Engine:
         self._decode_by_window: dict = {}
         cfg_windows = tuple(sorted(
             w for w in (cfg.decode_windows or ()) if 0 < w < cfg.max_seq))
+        #: raw configured windows — chunk walks use these even when
+        #: the decode path itself is the ragged kernel (native paged),
+        #: whose _decode_windows stays empty
+        self._cfg_windows = cfg_windows
         if cfg.kv_layout == "paged":
             from ..ops.paged_kv import (gather_view, scatter_decode,
                                         scatter_prefill)
@@ -646,22 +650,34 @@ class Engine:
             # both group sizes the walk uses (solo and full wave) —
             # all rows dummy (OOB slots/tables): every cache write
             # drops, the samples are discarded
-            fn = self._get_chunk_prefill()
             P = max(1, cfg.prefill_batch)
-            for width in self._usable_buckets:
-                for g in sorted({1, P}):
-                    if paged:
-                        slot_arg = jnp.full((g, self._pages_per_slot),
-                                            self._n_pages, jnp.int32)
-                    else:
-                        slot_arg = jnp.full(g, cfg.max_batch, jnp.int32)
-                    toks, self.k_cache, self.v_cache = fn(
-                        self.params, jnp.zeros((g, width), jnp.int32),
-                        self.k_cache, self.v_cache, slot_arg,
-                        jnp.zeros(g, jnp.int32), jnp.zeros(g, jnp.int32),
-                        np.int32(0), jnp.zeros(g, jnp.float32),
-                        jnp.ones(g, jnp.float32), jnp.zeros(g, jnp.int32))
-                    jax.block_until_ready(toks)
+            # full graph always; plus the single windowed chunk
+            # variant the walk dispatcher may select (paged + windows)
+            chunk_windows = [None]
+            if paged and self._cfg_windows:
+                chunk_windows.append(self._cfg_windows[-1])
+            for cw in chunk_windows:
+                fn = self._get_chunk_prefill(cw)
+                for width in self._usable_buckets:
+                    if cw is not None and width > cw:
+                        continue  # the dispatcher never picks cw then
+                    for g in sorted({1, P}):
+                        if paged:
+                            slot_arg = jnp.full(
+                                (g, self._pages_per_slot),
+                                self._n_pages, jnp.int32)
+                        else:
+                            slot_arg = jnp.full(g, cfg.max_batch,
+                                                jnp.int32)
+                        toks, self.k_cache, self.v_cache = fn(
+                            self.params, jnp.zeros((g, width), jnp.int32),
+                            self.k_cache, self.v_cache, slot_arg,
+                            jnp.zeros(g, jnp.int32),
+                            jnp.zeros(g, jnp.int32),
+                            np.int32(0), jnp.zeros(g, jnp.float32),
+                            jnp.ones(g, jnp.float32),
+                            jnp.zeros(g, jnp.int32))
+                        jax.block_until_ready(toks)
 
     def _clamp_prompt(self, tokens: list[int], max_new: int) -> list[int]:
         """Keep the tail of an over-long prompt, reserving room to
@@ -784,7 +800,7 @@ class Engine:
             self._prefill_cache[(bucket, group)] = fn
         return fn
 
-    def _get_chunk_prefill(self) -> Callable:
+    def _get_chunk_prefill(self, window: int | None = None) -> Callable:
         """Fused G-slot chunk step: bring each walking slot's cache
         rows into a contiguous view (an index gather for the slot
         layout, a page gather for the paged pool), run one [G, width]
@@ -795,18 +811,29 @@ class Engine:
         request, and a short tail pays for its own bucket, not the
         widest (a [1, 512] forward for a 4-token suffix was the r4
         bench's prefix-hit slowdown). Dummy pad rows carry OOB
-        slots/tables, so their writes drop."""
-        fn = self._prefill_cache.get("chunk")
+        slots/tables, so their writes drop.
+
+        ``window`` (paged only): gather/scatter only the table columns
+        covering the first ``window`` rows — prefix-suffix walks with
+        short histories stop paying O(max_seq) view traffic. The walk
+        dispatcher uses the LARGEST configured decode window (one
+        extra compile per (G, width)) and falls back to the full graph
+        when a walker's history outgrows it."""
+        fn = self._prefill_cache.get(("chunk", window))
         if fn is None:
             chunk_fn = self._prefill_chunk_fn
             base_key = self._prefill_base_key
 
             if self.config.kv_layout == "paged":
                 from ..ops.paged_kv import gather_view, scatter_decode
+                pg_rows = max(1, int(self.config.page_size))
+                mp_w = None if window is None else -(-window // pg_rows)
 
                 def fused(params, tokens, kp, vp, tables, offsets,
                           chunk_lens, step, temps, top_ps, top_ks):
                     width = tokens.shape[1]
+                    tables = (tables if mp_w is None
+                              else tables[:, :mp_w])
                     k_view = gather_view(kp, tables)
                     v_view = gather_view(vp, tables)
                     logits, k_view, v_view = chunk_fn(
@@ -843,8 +870,19 @@ class Engine:
                                          top_ps, top_ks)
                     return toks, kc, vc
             fn = jax.jit(fused, donate_argnums=(2, 3))
-            self._prefill_cache["chunk"] = fn
+            self._prefill_cache[("chunk", window)] = fn
         return fn
+
+    def _chunk_window(self, needed: int, width: int) -> int | None:
+        """Largest configured decode window, if it covers ``needed``
+        rows AND the chunk width (warmup only compiles windowed
+        variants for widths <= window — the gates must agree or the
+        first wide-bucket suffix walk compiles on the serving path).
+        Paged layout only; else None (full graph)."""
+        if self.config.kv_layout != "paged" or not self._cfg_windows:
+            return None
+        w = self._cfg_windows[-1]
+        return w if needed <= w and width <= w else None
 
     def _finish_walk(self, req: GenRequest, first: int) -> None:
         """A chunk walk covered its whole prompt: emit the first
@@ -980,7 +1018,11 @@ class Engine:
                                 if paged else r.slot
                         self._rng_step += 1
                         dispatched = ready
-                        toks, self.k_cache, self.v_cache = fn(
+                        cw = self._chunk_window(int((offs + lens).max()),
+                                                width)
+                        call = (self._get_chunk_prefill(cw) if cw
+                                else fn)
+                        toks, self.k_cache, self.v_cache = call(
                             self.params, jnp.asarray(tokens),
                             self.k_cache, self.v_cache,
                             jnp.asarray(slots_arg), jnp.asarray(offs),

@@ -215,3 +215,24 @@ def test_walker_does_not_starve_waiting_admission():
         assert long_req.error is None and len(long_req.generated) == 4
     finally:
         engine.stop()
+
+
+def test_paged_windowed_chunk_walk_matches_full():
+    """The windowed chunk-walk variant (gathers only the table columns
+    the largest configured window covers) must reproduce the full
+    graph's greedy output for long paged prompts — including walks
+    whose history outgrows the window and falls back mid-walk."""
+    base = dict(max_batch=2, max_seq=256, prefill_buckets=(16,), seed=7,
+                kv_layout="paged", page_size=16)
+    long_prompt = PROMPT + PROMPT  # 60 tokens -> 4 chunk passes
+
+    full = demo_llama_engine(EngineConfig(**base))
+    want, kept = _generate(full, long_prompt)
+    assert kept == len(long_prompt)
+
+    # window 48: the walk starts windowed (offsets 0,16,32 need <=48
+    # rows), outgrows it at offset 48, and falls back to full
+    windowed = demo_llama_engine(EngineConfig(decode_windows=(48,),
+                                              **base))
+    got, _ = _generate(windowed, long_prompt)
+    assert got == want
