@@ -171,7 +171,7 @@ flops = 2.0 * n_params * ((total_tokens - len(ok)) + len(ok) * prompt_len)
 mfu = round(flops / (wall * peak), 4) if peak else None
 # decode roofline: HBM-bound — every decode pass streams all params
 # once for up to max_batch tokens (bf16 = 2 B/param; int8 halves it)
-bytes_per_param = 1.0 if quant == "int8" else 2.0
+bytes_per_param = {"int8": 1.0, "int4": 0.5}.get(quant, 2.0)
 roof = (hbm * 1e9) / (bytes_per_param * n_params / max_batch) \
     if hbm else None
 # decode_s counts in-flight spans (pipelined passes overlap prefill/

@@ -7,7 +7,7 @@ Uses the tiny random-weight config by default so it runs anywhere.
 Point MODEL_PATH at an HF-format checkpoint directory
 (config.json + model.safetensors [+ tokenizer.json]) to serve real
 weights; or set MODEL_PRESET=llama3_1b (etc.) for a random-weight
-architecture twin. MODEL_QUANT=int8 enables weight-only quantization
+architecture twin. MODEL_QUANT=int8|int4 enables weight-only quantization
 (half the HBM traffic of the memory-bound decode) in either mode.
 """
 

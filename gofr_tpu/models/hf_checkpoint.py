@@ -238,7 +238,7 @@ def load_llama_checkpoint(directory: str | Path, *,
     ``(params, LlamaConfig)`` ready for ``serving.glue.llama_engine``.
 
     ``dtype`` overrides the serving dtype (default: the config's,
-    normally bfloat16); ``quantize="int8"`` quantizes weight matrices
+    normally bfloat16); ``quantize="int8"``/``"int4"`` quantizes weight matrices
     on load so the full-precision pytree never resides in device
     memory; ``max_seq`` caps the KV capacity below the checkpoint's
     ``max_position_embeddings`` (a 128k cache would not fit one chip).
@@ -257,11 +257,12 @@ def load_llama_checkpoint(directory: str | Path, *,
 
     tensor = _tensor_reader(directory)
 
-    if quantize not in (None, "int8"):
-        raise ValueError(f"quantize must be None or 'int8', "
+    if quantize not in (None, "int8", "int4"):
+        raise ValueError(f"quantize must be None, 'int8' or 'int4', "
                          f"got {quantize!r}")
     if quantize is not None:
-        from ..ops.quant import quantize_int8
+        from ..ops.quant import quantize_int4, quantize_int8
+        qfn = quantize_int8 if quantize == "int8" else quantize_int4
 
     c = config
     # cast straight from the memmap into the serving dtype: a float32
@@ -277,7 +278,7 @@ def load_llama_checkpoint(directory: str | Path, *,
             # per-tensor quantize as each tensor lands on device: only
             # this one tensor is ever full-precision there, never the
             # whole tree (the point of quantize-on-LOAD)
-            return quantize_int8(jnp.asarray(a), axis=quant_axis)
+            return qfn(jnp.asarray(a), axis=quant_axis)
         return jnp.asarray(a)
 
     def stack(key: str, suffix: str, transpose: bool) -> Any:

@@ -43,16 +43,18 @@ def llama_engine(params: Any, model_config: LlamaConfig,
     engine_config = engine_config or EngineConfig()
     c = model_config
     if quantize is not None:
-        if quantize != "int8":
-            raise ValueError(f"quantize must be None or 'int8', "
-                             f"got {quantize!r}")
-        # weight-only int8: halves HBM param streaming in the
-        # memory-bound decode (ops/quant.py); the model functions
-        # detect quantized leaves per-matrix, and the sharding specs
-        # descend into the {'q','s'} leaves (parallel/sharding.py
-        # _match_specs), so int8 composes with mesh serving
-        from ..ops.quant import quantize_llama_int8
-        params = quantize_llama_int8(params)
+        if quantize not in ("int8", "int4"):
+            raise ValueError(f"quantize must be None, 'int8' or "
+                             f"'int4', got {quantize!r}")
+        # weight-only quantization: int8 halves / int4 quarters the
+        # HBM param stream in the memory-bound decode (ops/quant.py);
+        # the model functions detect quantized leaves per-matrix, and
+        # the sharding specs descend into the {'q','s'} leaves
+        # (parallel/sharding.py _match_specs), so both compose with
+        # mesh serving
+        from ..ops.quant import quantize_llama_int4, quantize_llama_int8
+        params = (quantize_llama_int8(params) if quantize == "int8"
+                  else quantize_llama_int4(params))
 
     constrain_kv = None
     if mesh is not None:

@@ -106,8 +106,9 @@ def run_point(max_batch, k_steps, layout, n_requests=None,
     decode_toks = toks - len(ok)
     # roofline: in pure decode the pass streams all params once per
     # K-step x batch tokens — the bound this point is judged against.
-    # Weight-only int8 halves the streamed bytes, doubling the bound.
-    point_bytes = param_bytes / 2 if quantize == "int8" else param_bytes
+    # Weight-only int8 halves the streamed bytes (int4 quarters them).
+    point_bytes = param_bytes * {"int8": 0.5, "int4": 0.25}.get(
+        quantize, 1.0)
     roof_toks = (hbm * 1e9) / (point_bytes / max_batch) if hbm else None
     point = {
         "layout": layout, "paged_attention": paged_attention,
@@ -150,6 +151,8 @@ run_point(32, 8, "slot", quantize="int8")
 # the best-known composition: ragged kernel reads only live KV rows,
 # int8 halves the weight stream
 run_point(32, 8, "paged", paged_attention="kernel", quantize="int8")
+# int4: a quarter of the weight stream — the aggressive roofline point
+run_point(32, 8, "slot", quantize="int4")
 
 print("RESULT_JSON " + json.dumps({
     "job": "engine_sweep", "device": DEV, "n_params": n_params,
