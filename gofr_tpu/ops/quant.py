@@ -12,19 +12,20 @@ onto this representation).
 
 Representation: a quantized matrix is the dict ``{"q": int8/int4
 array, "s": f32 scales}`` — a plain pytree node, so optimizers/
-checkpoints/
-jit see ordinary leaves. Scales are per-output-channel (max-abs /
-127 over the contraction axis), the standard symmetric scheme;
-``x @ q * s`` applies the scale AFTER the matmul, so XLA reads int8
+checkpoints/jit see ordinary leaves. Scales are per-output-channel
+(max-abs over the contraction axis divided by the int range: 127 for
+int8, 7 for int4), the standard symmetric scheme; ``x @ q * s``
+applies the scale AFTER the matmul, so XLA reads the narrow integers
 from HBM and fuses the upcast into the matmul's operand load. Scales
 store as f32 (bandwidth noise — one scalar per output channel): the
 backbone dequant rounds them to the activation dtype anyway, but the
 f32 LM-head path keeps the full precision where logits are computed.
 
-``quantize_llama_int8`` rewrites a Llama parameter tree in place-shape:
-the seven per-layer matrices and the embedding (per-row scales — it
-serves both the input gather and, tied, the LM head). Norm gains stay
-in full precision (tiny, and sensitive).
+``quantize_llama_int8`` / ``quantize_llama_int4`` rewrite a Llama
+parameter tree in place-shape: the seven per-layer matrices and the
+embedding (per-row scales — it serves both the input gather and,
+tied, the LM head). Norm gains stay in full precision (tiny, and
+sensitive).
 """
 
 from __future__ import annotations
