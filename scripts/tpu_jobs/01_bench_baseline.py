@@ -1,6 +1,16 @@
 """TPU job: run the standard bench pinned to the TPU platform."""
 import os
 import runpy
+import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+# shared persistent compile cache for the bench children (jax-free
+# resolve — this wrapper, like bench's parent, never imports jax)
+from gofr_tpu.config.env import (COMPILE_CACHE_ENV,
+                                 resolve_compile_cache_dir)
+
+os.environ.setdefault(COMPILE_CACHE_ENV,
+                      resolve_compile_cache_dir() or "off")
 os.environ["GOFR_BENCH_PLATFORM"] = "tpu"
 runpy.run_path("bench.py", run_name="__main__")

@@ -27,6 +27,11 @@ if SMOKE:
     jax.config.update("jax_platforms", "cpu")
 if not SMOKE:
     assert jax.default_backend() != "cpu", "TPU job ran on CPU"
+
+# shared persistent XLA compile cache: this job's warmup compiles
+# amortize across every child in the round (config/env.py)
+from gofr_tpu.config.env import enable_compile_cache
+enable_compile_cache()
 out = {"job": "pallas_smoke", "backend": jax.default_backend(),
        "device": jax.devices()[0].device_kind}
 

@@ -2,9 +2,18 @@
 headline number next to 01's bf16 baseline."""
 import os
 import runpy
+import sys
 
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, _REPO)
+# shared persistent compile cache for the bench children (jax-free
+# resolve — this wrapper, like bench's parent, never imports jax)
+from gofr_tpu.config.env import (COMPILE_CACHE_ENV,
+                                 resolve_compile_cache_dir)
+
+os.environ.setdefault(COMPILE_CACHE_ENV,
+                      resolve_compile_cache_dir() or "off")
 os.environ["GOFR_BENCH_PLATFORM"] = "tpu"
 os.environ["GOFR_BENCH_QUANT"] = "int8"
-runpy.run_path(os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))), "bench.py"), run_name="__main__")
+runpy.run_path(os.path.join(_REPO, "bench.py"), run_name="__main__")

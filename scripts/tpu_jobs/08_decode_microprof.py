@@ -24,6 +24,11 @@ if SMOKE:
 if not SMOKE:
     assert jax.default_backend() != "cpu", "TPU job ran on CPU"
 
+# shared persistent XLA compile cache: this job's warmup compiles
+# amortize across every child in the round (config/env.py)
+from gofr_tpu.config.env import enable_compile_cache
+enable_compile_cache()
+
 from gofr_tpu.models.llama import (LlamaConfig, llama_init, make_empty_cache,
                                    llama_decode_step, param_count)
 

@@ -61,6 +61,15 @@ def _env_tpu() -> dict:
     # QUEUE dir (not the repo) on sys.path — gofr_tpu must resolve
     env["PYTHONPATH"] = REPO + (
         os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    # ONE shared persistent compile-cache dir for every job child, so
+    # warmup compiles amortize across the whole drain instead of being
+    # re-paid per job (the r5 window went ~10:1 to recompiles)
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from gofr_tpu.config.env import (COMPILE_CACHE_ENV,
+                                     resolve_compile_cache_dir)
+    env.setdefault(COMPILE_CACHE_ENV,
+                   resolve_compile_cache_dir() or "off")
     return env
 
 
@@ -125,6 +134,39 @@ _attempts: dict[str, int] = {}
 MAX_ATTEMPTS = 3
 
 
+def _parse_payload(stdout: str) -> dict | None:
+    """Last JSON-object line of a job's stdout (jobs print one JSON
+    artifact line by convention; bench lines may carry a BENCH_JSON
+    prefix). None when nothing parses."""
+    for line in reversed((stdout or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("BENCH_JSON "):
+            line = line[len("BENCH_JSON "):]
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict):
+            return obj
+    return None
+
+
+def _job_ok(rc, stdout: str) -> tuple[bool, str]:
+    """ok requires rc == 0 AND a parsed, non-error payload — a job
+    that prints an error payload and exits 0 (bench.py's containment
+    does exactly that) is a failed measurement, not a success."""
+    if rc != 0:
+        return False, f"rc={rc}"
+    payload = _parse_payload(stdout)
+    if payload is None:
+        return False, "no JSON payload in stdout"
+    if payload.get("error"):
+        return False, "payload carries an error field"
+    return True, ""
+
+
 def _run_job(path: str) -> None:
     name = os.path.basename(path)
     _attempts[name] = _attempts.get(name, 0) + 1
@@ -142,11 +184,13 @@ def _run_job(path: str) -> None:
         err = (e.stderr.decode() if isinstance(e.stderr, bytes) else (e.stderr or "")) \
             + f"\n[timeout after {JOB_TIMEOUT_S}s]"
     wall = round(time.time() - t0, 1)
-    ok = rc == 0
+    ok, why = _job_ok(rc, out)
     result = {"job": name, "ok": ok, "rc": rc, "wall_s": wall,
               "attempt": _attempts[name], "git_sha": _head_sha(),
               "stdout": out[-20000:], "stderr": err[-8000:],
               "ts": round(time.time(), 1)}
+    if not ok:
+        result["not_ok_why"] = why
     with open(os.path.join(RESULTS, name + ".json"), "w") as f:
         json.dump(result, f, indent=1)
     if not ok and _attempts[name] < MAX_ATTEMPTS:

@@ -1,0 +1,93 @@
+"""Persistent XLA compile-cache plumbing (``gofr_tpu.config.env``).
+
+One shared config path (``GOFR_COMPILE_CACHE_DIR`` -> default under
+``~/.cache``) resolves the ``jax_compilation_cache_dir`` for the
+engine, bench children and every TPU job, so warmup compiles amortize
+across processes instead of being re-paid per child."""
+
+import os
+import subprocess
+import sys
+
+from gofr_tpu.config.env import (COMPILE_CACHE_ENV, DictConfig,
+                                 default_compile_cache_dir,
+                                 enable_compile_cache,
+                                 resolve_compile_cache_dir)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_resolve_precedence_and_off(monkeypatch, tmp_path):
+    monkeypatch.delenv(COMPILE_CACHE_ENV, raising=False)
+    assert resolve_compile_cache_dir() == default_compile_cache_dir()
+    monkeypatch.setenv(COMPILE_CACHE_ENV, str(tmp_path))
+    assert resolve_compile_cache_dir() == str(tmp_path)
+    for off in ("off", "none", "0", "FALSE"):
+        monkeypatch.setenv(COMPILE_CACHE_ENV, off)
+        assert resolve_compile_cache_dir() is None
+    # a Config layer wins over the OS environment fallback
+    cfg = DictConfig({COMPILE_CACHE_ENV: "/somewhere/else"})
+    assert resolve_compile_cache_dir(cfg) == "/somewhere/else"
+
+
+def test_enable_points_jax_at_directory(tmp_path):
+    import jax
+    target = str(tmp_path / "cache")
+    try:
+        assert enable_compile_cache(target) == target
+        assert jax.config.jax_compilation_cache_dir == target
+        assert os.path.isdir(target)
+        assert enable_compile_cache(None) is None  # disabled = no-op
+        assert jax.config.jax_compilation_cache_dir == target
+    finally:
+        # restore the shared default so later engines in this process
+        # aren't pinned to the tmpdir
+        enable_compile_cache("auto")
+
+
+def test_engine_config_field_applies_cache_dir(tmp_path):
+    import jax
+
+    from gofr_tpu.serving.engine import EngineConfig
+    from gofr_tpu.serving.glue import demo_llama_engine
+    target = str(tmp_path / "engine-cache")
+    try:
+        demo_llama_engine(EngineConfig(max_batch=2, max_seq=64,
+                                       compile_cache_dir=target))
+        assert jax.config.jax_compilation_cache_dir == target
+    finally:
+        enable_compile_cache("auto")
+
+
+_CHILD = """
+import os
+import jax
+import jax.numpy as jnp
+from gofr_tpu.config.env import enable_compile_cache
+path = enable_compile_cache()
+assert path == os.environ["GOFR_COMPILE_CACHE_DIR"], path
+f = jax.jit(lambda x: (x @ x + jnp.float32(3)).sum())
+f(jnp.ones((32, 32), jnp.float32)).block_until_ready()
+print("CACHE_FILES",
+      len([n for n in os.listdir(path) if n.endswith("-cache")]))
+"""
+
+
+def test_children_share_cache_across_processes(tmp_path):
+    """Two child processes compiling the same graph: the first
+    populates the shared directory, the second gets pure cache hits
+    (no new entries) — the amortization the TPU jobs rely on."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env[COMPILE_CACHE_ENV] = str(tmp_path)
+
+    def run():
+        p = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+                           capture_output=True, text=True,
+                           timeout=180, cwd=REPO)
+        assert p.returncode == 0, p.stderr[-2000:]
+        return int(p.stdout.strip().rsplit(" ", 1)[-1])
+
+    first = run()
+    assert first > 0, "first child compiled nothing into the cache"
+    second = run()
+    assert second == first, (first, second)

@@ -93,8 +93,8 @@ def test_load_roundtrips_params_exactly(tiny_checkpoint):
     loaded, lcfg = load_llama_checkpoint(directory, dtype=jnp.float32)
     assert lcfg.dim == cfg.dim and lcfg.n_layers == cfg.n_layers
     assert lcfg.tie_embeddings == cfg.tie_embeddings
-    flat_want = jax.tree.leaves_with_path(params)
-    flat_got = dict(jax.tree.leaves_with_path(loaded))
+    flat_want = jax.tree_util.tree_leaves_with_path(params)
+    flat_got = dict(jax.tree_util.tree_leaves_with_path(loaded))
     assert len(flat_want) == len(flat_got)
     for path, want in flat_want:
         got = flat_got[path]
@@ -220,8 +220,8 @@ def test_whisper_checkpoint_roundtrip(tmp_path):
 
     loaded, lcfg = load_whisper_checkpoint(tmp_path, dtype=jnp.float32)
     assert lcfg.dim == cfg.dim and lcfg.n_mels == cfg.n_mels
-    flat_want = dict(jax.tree.leaves_with_path(params))
-    flat_got = dict(jax.tree.leaves_with_path(loaded))
+    flat_want = dict(jax.tree_util.tree_leaves_with_path(params))
+    flat_got = dict(jax.tree_util.tree_leaves_with_path(loaded))
     assert set(flat_want) == set(flat_got)
     for path, want in flat_want.items():
         np.testing.assert_array_equal(

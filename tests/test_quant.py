@@ -153,9 +153,26 @@ def test_int4_roundtrip_bounds():
     qw = quantize_int4(w, axis=0)
     assert str(qw["q"].dtype) == "int4"
     deq = np.asarray(qw["q"].astype(jnp.float32) * qw["s"])
-    # max error bounded by half a quantization step per channel
-    step = np.asarray(qw["s"])[0]
-    assert (np.abs(deq - np.asarray(w)) <= step / 2 + 1e-6).all()
+    # full-range scheme (scale = amax/8): error <= half a step per
+    # element, except weights in the top half-step below +amax — the
+    # exact-amax guard clips their unrepresentable +8 down to +7, so
+    # their error is bounded by one step instead
+    step = np.broadcast_to(np.asarray(qw["s"])[0], w.shape)
+    err = np.abs(deq - np.asarray(w))
+    clipped = np.asarray(w) > 7.5 * step - 1e-6
+    assert (err[~clipped] <= step[~clipped] / 2 + 1e-6).all()
+    assert (err <= step + 1e-6).all()
+
+
+def test_int4_uses_full_range():
+    """scale = amax/8 must actually reach the -8 code point (the old
+    [-7, 7] scheme wasted it) and pin +amax to +7."""
+    w = jnp.asarray([[-1.0, -0.97, 0.5, 1.0]], jnp.float32).T  # [4, 1]
+    qw = quantize_int4(w, axis=0)
+    q = np.asarray(qw["q"].astype(jnp.int8)).ravel()
+    assert q.min() == -8          # -amax -> -8 exactly
+    assert q.max() == 7           # +amax clipped by the guard
+    assert np.isclose(np.asarray(qw["s"]).ravel()[0], 1.0 / 8.0)
 
 
 def test_int4_engine_serves_and_is_deterministic():
@@ -189,3 +206,14 @@ def test_int4_quarter_bytes():
     # tiny config is f32 (4 B/param): int4 storage should be ~1/8th
     # plus scale overhead
     assert after < before / 6
+
+
+def test_quantized_bytes_dtype_detection():
+    """Explicit dtype comparison, not substring matching: int4 AND
+    uint4 count the packed half byte; everything else counts its
+    itemsize."""
+    tree = {"a": jnp.zeros((10,), jnp.int4),
+            "b": jnp.zeros((10,), jnp.uint4),
+            "c": jnp.zeros((10,), jnp.int8),
+            "d": jnp.zeros((10,), jnp.float32)}
+    assert quantized_bytes(tree) == int(10 * 0.5 + 10 * 0.5 + 10 + 40)

@@ -119,6 +119,11 @@ def _generate_modern(mesh):
         EngineConfig(max_batch=4, max_seq=128, prefill_buckets=(16, 32),
                      seed=11, kv_layout="paged", page_size=16,
                      prefix_cache=True, speculative=True, spec_draft=3,
+                     # drafting is consulted only at pass boundaries
+                     # (the matched tail ends at the boundary token):
+                     # short passes + 1-gram lookup make engagement
+                     # deterministic within the tiny token budget
+                     spec_ngram=1, decode_steps_per_pass=2,
                      pipeline_depth=1),
         mesh=mesh, implementation="xla")
     eng.start()

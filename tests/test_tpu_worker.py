@@ -1,0 +1,52 @@
+"""TPU worker result semantics: ``ok`` must mean a real measurement.
+
+A job that prints an error payload and exits 0 (bench.py's containment
+path does exactly that) used to be recorded as a success; ``ok`` now
+requires rc == 0 AND a parsed, non-error JSON payload."""
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_worker():
+    spec = importlib.util.spec_from_file_location(
+        "tpu_worker", os.path.join(REPO, "scripts", "tpu_worker.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_ok_requires_parsed_non_error_payload():
+    w = _load_worker()
+    good = '# init stuff\n{"metric": "chat_req_per_s", "value": 23.6}\n'
+    err0 = ('{"metric": "chat_req_per_s", "value": 0.0, '
+            '"error": "tpu: backend probe failed"}\n')
+    assert w._job_ok(0, good) == (True, "")
+    # error payload + rc 0: the failure mode this fix exists for
+    ok, why = w._job_ok(0, err0)
+    assert not ok and "error" in why
+    # no payload at all
+    ok, why = w._job_ok(0, "warmup compile 12.3s\nall done\n")
+    assert not ok and "payload" in why
+    # non-zero rc always fails, payload or not
+    ok, why = w._job_ok(1, good)
+    assert not ok and "rc=1" in why
+    # timeout path records rc None
+    ok, why = w._job_ok(None, good)
+    assert not ok
+
+
+def test_parse_payload_variants():
+    w = _load_worker()
+    # last JSON line wins; BENCH_JSON prefix is stripped
+    out = ('{"old": 1}\n'
+           'BENCH_JSON {"metric": "x", "value": 2.0}\n'
+           '# trailing comment\n')
+    assert w._parse_payload(out) == {"metric": "x", "value": 2.0}
+    assert w._parse_payload("") is None
+    assert w._parse_payload("{not json}") is None
+    # non-dict JSON lines are skipped
+    assert w._parse_payload("[1, 2, 3]") is None
