@@ -426,6 +426,88 @@ def llama_prefill_chunk(params: dict, tokens: jnp.ndarray,
     return _logits(params, c, last), new_k, new_v
 
 
+def llama_prefill_chunk_paged(params: dict, tokens: jnp.ndarray,
+                              k_pool: jnp.ndarray, v_pool: jnp.ndarray,
+                              tables: jnp.ndarray, offsets: jnp.ndarray,
+                              chunk_lengths: jnp.ndarray,
+                              config: LlamaConfig, *,
+                              implementation: str = "auto",
+                              return_all_logits: bool = False
+                              ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One chunk of a chunked prefill straight against the paged pool.
+
+    The generic paged chunk path gathers a dense per-slot view of the
+    WHOLE pool allocation, runs :func:`llama_prefill_chunk` on it and
+    scatters back — O(full-cache) HBM traffic per chunk, which
+    dominates TTFT for long prompts. This variant writes each layer's
+    chunk K/V through the block table (only the pages the chunk spans)
+    and attends with the ragged chunk kernel
+    (:func:`..ops.paged_attention.paged_chunk_attention`), so the pool
+    is only ever touched in place — the prefill-side twin of
+    :func:`llama_decode_step_paged`.
+
+    tokens [B, S] start at absolute positions ``offsets`` per row;
+    pools [L, Hkv, Np, pg, hd] (head-major); tables [B, Mp]. Rows past
+    ``chunk_lengths[b]`` are padding: their writes drop (OOB page id)
+    and their logits are garbage the caller discards. Returns
+    (last-position logits [B, V] — or all positions [B, S, V] with
+    ``return_all_logits`` for speculative verify — new_k_pool,
+    new_v_pool); pools are meant to be donated.
+    """
+    from ..ops.paged_attention import paged_chunk_attention
+    c = config
+    b, s = tokens.shape
+    hd = c.head_dim
+    pg = k_pool.shape[3]
+    n_pages = k_pool.shape[2]
+    mp = tables.shape[1]
+    inv_freq = rope_frequencies(c.head_dim, c.rope_theta, c.rope_scaling)
+    positions = offsets[:, None] + jnp.arange(s)[None, :]      # [B, S]
+    valid = jnp.arange(s)[None, :] < chunk_lengths[:, None]    # [B, S]
+    # page id + in-page offset per written position; padding rows and
+    # positions past the table map to the OOB id and drop on scatter
+    pids = jnp.take_along_axis(
+        tables, jnp.clip(positions // pg, 0, mp - 1), axis=1)  # [B, S]
+    pids = jnp.where(valid & (positions < mp * pg), pids, n_pages)
+    offs = positions % pg
+    x = qgather(params["embed"], tokens, c.dtype)
+
+    # pools ride the scan carry (see llama_decode_step_paged); the
+    # advanced-index write puts the broadcast [B, S] index result in
+    # front of the sliced head axis, so the update value is the raw
+    # [B, S, Hkv, hd] chunk K/V with no transpose
+    def layer_fn(carry, scanned):
+        x, kp_all, vp_all = carry     # [L, Hkv, Np, pg, hd]
+        lp, li = scanned
+        h = rms_norm(x, lp["attn_norm"], c.norm_eps)
+        q = qmatmul(h, lp["wq"]).reshape(b, s, c.n_heads, hd)
+        k = qmatmul(h, lp["wk"]).reshape(b, s, c.n_kv_heads, hd)
+        v = qmatmul(h, lp["wv"]).reshape(b, s, c.n_kv_heads, hd)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        kp_all = kp_all.at[li, :, pids, offs].set(
+            k.astype(kp_all.dtype), mode="drop")
+        vp_all = vp_all.at[li, :, pids, offs].set(
+            v.astype(vp_all.dtype), mode="drop")
+        kp = jax.lax.dynamic_index_in_dim(kp_all, li, 0, keepdims=False)
+        vp = jax.lax.dynamic_index_in_dim(vp_all, li, 0, keepdims=False)
+        out = paged_chunk_attention(q, kp, vp, tables, offsets,
+                                    chunk_lengths,
+                                    implementation=implementation)
+        x = x + qmatmul(out.reshape(b, s, c.n_heads * hd), lp["wo"])
+        x = x + _mlp_block(x, lp, c)
+        return (x, kp_all, vp_all), None
+
+    (x, new_k, new_v), _ = jax.lax.scan(
+        layer_fn, (x, k_pool, v_pool),
+        (params["layers"], jnp.arange(c.n_layers)))
+    if return_all_logits:
+        return _logits(params, c, x), new_k, new_v
+    last = jnp.take_along_axis(
+        x, jnp.maximum(chunk_lengths - 1, 0)[:, None, None], axis=1)[:, 0]
+    return _logits(params, c, last), new_k, new_v
+
+
 def make_empty_cache(config: LlamaConfig, batch: int,
                      max_seq: int | None = None) -> tuple[jnp.ndarray, jnp.ndarray]:
     c = config

@@ -31,6 +31,16 @@ Layouts (decode, Sq == 1):
 ``paged_decode_attention`` dispatches: 'pallas' (TPU), 'interpret'
 (kernel under the interpreter — CPU tests), 'xla' (gather fallback),
 'auto' (pallas on TPU, xla elsewhere).
+
+The same shape generalises to ragged QUERY blocks
+(``paged_chunk_attention``): chunked prefill, prefix-cache suffix
+reattachment and speculative verify all feed Sq > 1 new positions per
+slot against a per-slot history already in the pool. The grid gains a
+q-block axis, each (slot, kv-head, q-block) cell walks only the pages
+covering ``history + min((qb+1)·BQ, chunk_len)`` rows, and the causal
+mask compares page positions against ``history + q_index``. This is
+the prefill-side twin of the decode kernel: with it, no serving hot
+path materialises a dense per-slot view of the pool.
 """
 
 from __future__ import annotations
@@ -219,6 +229,233 @@ def paged_decode_attention_xla(q: jnp.ndarray, k_pool: jnp.ndarray,
         b, max_pages * page, hkv, hd)
     return decode_attention(q[:, None], k_view, v_view, lengths,
                             scale=scale)[:, 0]
+
+
+# ----------------------------------------------------- chunk (Sq > 1)
+
+def _paged_chunk_kernel(tables_ref, history_ref, chunk_ref, q_ref,
+                        k_hbm, v_hbm, o_ref, k_buf, v_buf, acc_ref,
+                        m_ref, l_ref, sems, *, page: int,
+                        pages_per_chunk: int, max_pages: int,
+                        n_pages: int, scale: float, block_q: int,
+                        group: int):
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    qb = pl.program_id(2)
+    chunk = pages_per_chunk * page
+    hist = history_ref[b]
+    clen = chunk_ref[b]
+    # rows this q-block may attend to: the full history plus the
+    # in-chunk causal prefix ending at the block's last row, bounded
+    # by what the chunk actually wrote. clen == 0 rows are padding —
+    # they read whatever the walk covers and are discarded upstream.
+    kv_limit = hist + jnp.minimum((qb + 1) * block_q, clen)
+    n_chunks = jnp.maximum(pl.cdiv(kv_limit, chunk), 1)
+
+    def start_chunk(ci, slot):
+        for j in range(pages_per_chunk):
+            page_idx = jnp.minimum(ci * pages_per_chunk + j,
+                                   max_pages - 1)
+            pid = jnp.minimum(tables_ref[b, page_idx], n_pages - 1)
+            pltpu.make_async_copy(
+                k_hbm.at[h, pid],
+                k_buf.at[slot, pl.ds(j * page, page), :],
+                sems.at[slot, 0, j]).start()
+            pltpu.make_async_copy(
+                v_hbm.at[h, pid],
+                v_buf.at[slot, pl.ds(j * page, page), :],
+                sems.at[slot, 1, j]).start()
+
+    def wait_chunk(ci, slot):
+        for j in range(pages_per_chunk):
+            page_idx = jnp.minimum(ci * pages_per_chunk + j,
+                                   max_pages - 1)
+            pid = jnp.minimum(tables_ref[b, page_idx], n_pages - 1)
+            pltpu.make_async_copy(
+                k_hbm.at[h, pid],
+                k_buf.at[slot, pl.ds(j * page, page), :],
+                sems.at[slot, 0, j]).wait()
+            pltpu.make_async_copy(
+                v_hbm.at[h, pid],
+                v_buf.at[slot, pl.ds(j * page, page), :],
+                sems.at[slot, 1, j]).wait()
+
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    start_chunk(0, 0)
+    rows = block_q * group
+    # q arrives pre-flattened to [BQ*G, hd] rows: row r is query index
+    # r // group, at absolute position history + qb*BQ + r//group
+    q_pos = hist + qb * block_q + \
+        jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0) // group
+    qf = q_ref[0, 0].astype(jnp.float32) * scale        # [BQ*G, hd]
+
+    def body(ci, _):
+        slot = jax.lax.rem(ci, 2)
+
+        @pl.when(ci + 1 < n_chunks)
+        def _():
+            start_chunk(ci + 1, jax.lax.rem(ci + 1, 2))
+
+        wait_chunk(ci, slot)
+        k = k_buf[slot].astype(jnp.float32)             # [chunk, hd]
+        s = jax.lax.dot_general(
+            qf, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [BQ*G, chunk]
+        pos = ci * chunk + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        # causal against history + in-chunk prefix: position p is
+        # visible to query q_idx iff p <= history + q_idx (the chunk's
+        # own row q_idx was written before attention, like decode)
+        visible = pos <= q_pos
+        s = jnp.where(visible, s, NEG_INF)
+
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        # mask p explicitly: a fully-masked row has s == m_new ==
+        # NEG_INF and exp(s - m_new) would be 1
+        p = jnp.where(visible, jnp.exp(s - m_new), 0.0)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v_buf[slot].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [BQ*G, hd]
+        m_ref[:] = m_new
+        return 0
+
+    jax.lax.fori_loop(0, n_chunks, body, 0)
+    denom = jnp.maximum(l_ref[:], 1e-30)  # all-masked rows: zeros
+    o_ref[0, 0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def _pick_block_q(sq: int) -> int:
+    """Largest power-of-two divisor of Sq, capped at 128 (one MXU pass
+    of q rows); non-power-of-two chunk widths fall back to smaller
+    divisors so the grid tiles Sq exactly."""
+    for cand in (128, 64, 32, 16, 8, 4, 2, 1):
+        if sq % cand == 0:
+            return min(cand, sq)
+    return 1
+
+
+def paged_chunk_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
+                                 v_pool: jnp.ndarray, tables: jnp.ndarray,
+                                 history_lens: jnp.ndarray,
+                                 chunk_lens: jnp.ndarray, *,
+                                 scale: float | None = None,
+                                 block_q: int | None = None,
+                                 interpret: bool = False) -> jnp.ndarray:
+    """Ragged chunk attention. q [B, Sq, Hq, hd] holds Sq new positions
+    per slot, already written into the pool at rows
+    ``[history_lens, history_lens + chunk_lens)``; pools
+    [Hkv, Np, pg, hd]. Query row i of slot b attends causally to pool
+    rows <= history_lens[b] + i. Rows past ``chunk_lens[b]`` are
+    padding: their output is finite garbage the caller discards."""
+    b, sq, hq, hd = q.shape
+    hkv, n_pages, page, _ = k_pool.shape
+    _, max_pages = tables.shape
+    group = hq // hkv
+    scale = scale if scale is not None else hd ** -0.5
+    if block_q is None:
+        block_q = _pick_block_q(sq)
+    if sq % block_q != 0:
+        raise ValueError(f"block_q {block_q} must divide Sq {sq}")
+
+    pages_per_chunk = max(1, min(max_pages, -(-128 // page)))
+    chunk = pages_per_chunk * page
+
+    # [B, Hkv, Sq*G, hd]: q rows flattened OUTSIDE the kernel so each
+    # grid cell reads a plain 2D [BQ*G, hd] block — the q-block axis
+    # slices the (tiled) second-to-last dim in BQ*G-row steps, which
+    # stays tile-aligned for the serving shapes (BQ is a power of two;
+    # widths < 8 only occur in CPU interpret tests where Mosaic's
+    # tiling constraint doesn't apply)
+    q4 = q.reshape(b, sq, hkv, group, hd).transpose(0, 2, 1, 3, 4) \
+        .reshape(b, hkv, sq * group, hd)
+    kernel = functools.partial(
+        _paged_chunk_kernel, page=page, pages_per_chunk=pages_per_chunk,
+        max_pages=max_pages, n_pages=n_pages, scale=scale,
+        block_q=block_q, group=group)
+    rows = block_q * group
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, hkv, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, rows, hd),
+                         lambda i, j, k, *_: (i, j, k, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),      # k pool stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),      # v pool stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, 1, rows, hd),
+                               lambda i, j, k, *_: (i, j, k, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk, hd), k_pool.dtype),
+            pltpu.VMEM((2, chunk, hd), v_pool.dtype),
+            pltpu.VMEM((rows, hd), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2, pages_per_chunk)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, sq * group, hd),
+                                       q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel")),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), history_lens.astype(jnp.int32),
+      chunk_lens.astype(jnp.int32), q4, k_pool, v_pool)
+    return out.reshape(b, hkv, sq, group, hd) \
+        .transpose(0, 2, 1, 3, 4).reshape(b, sq, hq, hd)
+
+
+def paged_chunk_attention_xla(q: jnp.ndarray, k_pool: jnp.ndarray,
+                              v_pool: jnp.ndarray, tables: jnp.ndarray,
+                              history_lens: jnp.ndarray,
+                              chunk_lens: jnp.ndarray, *,
+                              scale: float | None = None) -> jnp.ndarray:
+    """Reference path: gather the slot views, run dense causal
+    attention offset by the history. Materialises [B, Mp*pg, Hkv, hd]
+    per call — the traffic the kernel exists to avoid."""
+    from .attention import xla_attention
+    hkv, n_pages, page, hd = k_pool.shape
+    b, max_pages = tables.shape
+    safe = jnp.minimum(tables, n_pages - 1)
+    k_view = k_pool[:, safe].transpose(1, 2, 3, 0, 4).reshape(
+        b, max_pages * page, hkv, hd)
+    v_view = v_pool[:, safe].transpose(1, 2, 3, 0, 4).reshape(
+        b, max_pages * page, hkv, hd)
+    return xla_attention(q, k_view, v_view, causal=True,
+                         q_offset=history_lens,
+                         kv_lengths=history_lens + chunk_lens,
+                         scale=scale)
+
+
+def paged_chunk_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
+                          v_pool: jnp.ndarray, tables: jnp.ndarray,
+                          history_lens: jnp.ndarray,
+                          chunk_lens: jnp.ndarray, *,
+                          scale: float | None = None,
+                          implementation: str = "auto") -> jnp.ndarray:
+    """Dispatch wrapper. implementation: 'pallas'|'interpret'|'xla'|'auto'."""
+    if implementation == "pallas" or (
+            implementation == "auto" and _is_tpu()):
+        return paged_chunk_attention_pallas(q, k_pool, v_pool, tables,
+                                            history_lens, chunk_lens,
+                                            scale=scale)
+    if implementation == "interpret":
+        return paged_chunk_attention_pallas(q, k_pool, v_pool, tables,
+                                            history_lens, chunk_lens,
+                                            scale=scale, interpret=True)
+    return paged_chunk_attention_xla(q, k_pool, v_pool, tables,
+                                     history_lens, chunk_lens, scale=scale)
 
 
 def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,

@@ -66,6 +66,32 @@ def scatter_prefill(pool: jnp.ndarray, tables: jnp.ndarray,
     return pool.at[:, :, pids, offs].set(slab, mode="drop")
 
 
+def scatter_chunk(pool: jnp.ndarray, tables: jnp.ndarray,
+                  slab: jnp.ndarray, offsets: jnp.ndarray,
+                  chunk_lens: jnp.ndarray) -> jnp.ndarray:
+    """Write a chunk slab [L, P, S, H, d] whose row b covers logical
+    positions ``[offsets[b], offsets[b] + chunk_lens[b])`` into the
+    pool — touching only the pages the chunk spans. ``scatter_prefill``
+    writes every slab position of every row (pad rows past a prompt's
+    real length included, dropped only where the table has no page);
+    here rows past ``chunk_lens`` and positions past the table map to
+    the OOB page id and drop, so a 5-token suffix in a 512-wide bucket
+    writes one page, not the slot's whole allocation.
+    """
+    pg = pool.shape[3]
+    n_pages = pool.shape[2]
+    mp = tables.shape[1]
+    s = slab.shape[2]
+    pos = offsets[:, None] + jnp.arange(s)[None, :]             # [P, S]
+    valid = jnp.arange(s)[None, :] < chunk_lens[:, None]        # [P, S]
+    pids = jnp.take_along_axis(
+        tables, jnp.clip(pos // pg, 0, mp - 1), axis=1)         # [P, S]
+    pids = jnp.where(valid & (pos < mp * pg), pids, n_pages)
+    offs = pos % pg
+    rows = slab.transpose(0, 3, 1, 2, 4)                # [L, H, P, S, d]
+    return pool.at[:, :, pids, offs].set(rows, mode="drop")
+
+
 def scatter_decode(pool: jnp.ndarray, tables: jnp.ndarray,
                    view: jnp.ndarray, lengths: jnp.ndarray,
                    k_steps: int) -> jnp.ndarray:

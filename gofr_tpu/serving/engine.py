@@ -251,6 +251,8 @@ class Engine:
                  make_cache: Callable, prefill_chunk_fn: Callable
                  | None = None, spec_verify_fn: Callable | None = None,
                  paged_decode_fn: Callable | None = None,
+                 paged_chunk_fn: Callable | None = None,
+                 paged_verify_fn: Callable | None = None,
                  metrics: Any = None,
                  logger: Any = None) -> None:
         self.params = params
@@ -260,9 +262,13 @@ class Engine:
         self._make_cache = make_cache
         # chunked prefill: long prompts in bucket-width chunks against
         # the growing cache (slot layout slices the cache; the paged
-        # layout gathers the slot's view and scatters the chunk back)
+        # layout writes pages in place via paged_chunk_fn when the
+        # ragged kernel path is active, else gathers the slot's view
+        # and scatters the chunk back)
         self._prefill_chunk_fn = prefill_chunk_fn
         self._spec_verify_fn = spec_verify_fn
+        self._paged_chunk_fn = paged_chunk_fn
+        self._paged_verify_fn = paged_verify_fn
         self._spec_enabled = (config.speculative
                               and spec_verify_fn is not None)
         self._spec_toggle = True  # mixed-batch alternation state
@@ -342,14 +348,25 @@ class Engine:
         #: the decode path itself is the ragged kernel (native paged),
         #: whose _decode_windows stays empty
         self._cfg_windows = cfg_windows
+        #: native paged hot paths: the model family writes rows/chunks
+        #: through the block tables and attends with the ragged paged
+        #: kernels — no per-pass dense view of the pool. Chunked
+        #: prefill, prefix-suffix reattachment and speculative verify
+        #: follow decode onto the native path whenever the kernel path
+        #: is active and the family supplies the paged chunk step.
+        self._native_chunk = False
+        self._native_verify = False
         if cfg.kv_layout == "paged":
-            from ..ops.paged_kv import (gather_view, scatter_decode,
-                                        scatter_prefill)
-            self._scatter_prefill = scatter_prefill
+            from ..ops.paged_kv import (gather_view, scatter_chunk,
+                                        scatter_decode)
+            self._scatter_chunk = scatter_chunk
             use_native = paged_decode_fn is not None and (
                 cfg.paged_attention in ("kernel", "interpret", "xla")
                 or (cfg.paged_attention == "auto"
                     and jax.default_backend() == "tpu"))
+            self._native_chunk = use_native and paged_chunk_fn is not None
+            self._native_verify = use_native and \
+                paged_verify_fn is not None
 
             if use_native:
                 def _decode_sample(params, tokens, use_prev, prev,
@@ -576,6 +593,7 @@ class Engine:
                       "decode_passes": 0, "decode_s": 0.0,
                       "dispatch_s": 0.0, "collect_s": 0.0,
                       "h2d_transfers": 0, "sched_syncs": 0,
+                      "view_bytes_avoided": 0,
                       "prefix_hits": 0, "spec_passes": 0,
                       "spec_accepted": 0, "spec_drafted": 0,
                       "spec_rows": 0}
@@ -743,9 +761,11 @@ class Engine:
             # drops, the samples are discarded
             P = max(1, cfg.prefill_batch)
             # full graph always; plus the single windowed chunk
-            # variant the walk dispatcher may select (paged + windows)
+            # variant the walk dispatcher may select (paged + windows;
+            # the native chunk path is length-bounded and never picks
+            # a windowed variant)
             chunk_windows = [None]
-            if paged and self._cfg_windows:
+            if paged and self._cfg_windows and not self._native_chunk:
                 chunk_windows.append(self._cfg_windows[-1])
             for cw in chunk_windows:
                 fn = self._get_chunk_prefill(cw)
@@ -865,7 +885,7 @@ class Engine:
             prefill_fn = self._prefill_fn
 
             paged = self.config.kv_layout == "paged"
-            scatter_prefill = getattr(self, "_scatter_prefill", None)
+            scatter_chunk = getattr(self, "_scatter_chunk", None)
 
             def fused(params, tokens, kv_len, kc, vc, slots, step,
                       temps, top_ps, top_ks, rng_key):
@@ -877,9 +897,15 @@ class Engine:
                         axis=1)[:, 0]
                 toks = _sample_batch(logits, key, temps, top_ps, top_ks)
                 if paged:
-                    # ``slots`` carries each row's block table [P, Mp]
-                    kc = scatter_prefill(kc, slots, k.astype(kc.dtype))
-                    vc = scatter_prefill(vc, slots, v.astype(vc.dtype))
+                    # ``slots`` carries each row's block table [P, Mp];
+                    # scatter_chunk (offset 0, per-row prompt length)
+                    # writes only the pages each prompt spans — pad
+                    # rows past kv_len drop instead of round-tripping
+                    zeros = jnp.zeros_like(kv_len)
+                    kc = scatter_chunk(kc, slots, k.astype(kc.dtype),
+                                       zeros, kv_len)
+                    vc = scatter_chunk(vc, slots, v.astype(vc.dtype),
+                                       zeros, kv_len)
                 else:
                     s = k.shape[2]
                     kc = kc.at[:, slots, :s].set(k.astype(kc.dtype),
@@ -914,7 +940,27 @@ class Engine:
         if fn is None:
             chunk_fn = self._prefill_chunk_fn
 
-            if self.config.kv_layout == "paged":
+            if self._native_chunk:
+                # native paged chunk: the model writes only the pages
+                # the chunk spans through the block tables and attends
+                # with the ragged chunk kernel — no gather/scatter of
+                # a dense per-slot view, so a chunk's HBM traffic is
+                # O(history + chunk), not O(pool allocation). The walk
+                # is length-bounded by construction; windowed variants
+                # exist only to bound the VIEW path's gather.
+                native_fn = self._paged_chunk_fn
+
+                def fused(params, tokens, kp, vp, tables, offsets,
+                          chunk_lens, step, temps, top_ps, top_ks,
+                          rng_key):
+                    logits, kp, vp = native_fn(
+                        params, tokens, kp, vp, tables, offsets,
+                        chunk_lens)
+                    key = jax.random.fold_in(rng_key, step)
+                    toks = _sample_batch(logits, key, temps,
+                                         top_ps, top_ks)
+                    return toks, kp, vp
+            elif self.config.kv_layout == "paged":
                 from ..ops.paged_kv import gather_view, scatter_decode
                 pg_rows = max(1, int(self.config.page_size))
                 mp_w = None if window is None else -(-window // pg_rows)
@@ -970,8 +1016,11 @@ class Engine:
         rows AND the chunk width (warmup only compiles windowed
         variants for widths <= window — the gates must agree or the
         first wide-bucket suffix walk compiles on the serving path).
-        Paged layout only; else None (full graph)."""
-        if self.config.kv_layout != "paged" or not self._cfg_windows:
+        Paged layout only; else None (full graph). The native chunk
+        path needs no windows at all — the ragged kernel walks only
+        the pages covering each row's history + chunk."""
+        if self.config.kv_layout != "paged" or not self._cfg_windows \
+                or self._native_chunk:
             return None
         w = self._cfg_windows[-1]
         return w if needed <= w and width <= w else None
@@ -1126,6 +1175,8 @@ class Engine:
                             jnp.asarray(top_ks),
                             self._prefill_base_key)
                         self.stats["prefill_calls"] += 1
+                        if self._native_chunk:
+                            self._note_view_avoided(G)
                         toks_np = None
                         for row, r in enumerate(ready):
                             r.prefill_offset += int(lens[row])
@@ -1607,6 +1658,21 @@ class Engine:
                 if self._finished(req, first):
                     self._retire(slot)
 
+    def _note_view_avoided(self, n_rows: int) -> None:
+        """Account HBM bytes a dense-view round trip would have moved
+        for a dispatch of ``n_rows`` slots that ran on the native
+        paged path instead (gather of the K and V per-slot views; the
+        write-back scatter is smaller and not counted). Surfaced in
+        ``stats`` next to ``h2d_transfers`` as the paged twin of the
+        transfer counters: steady native serving grows it every chunk/
+        verify dispatch, the view path leaves it flat."""
+        if self.config.kv_layout != "paged":
+            return
+        l, hkv, _, pg, hd = self.k_cache.shape
+        row_bytes = l * hkv * hd * self.k_cache.dtype.itemsize
+        self.stats["view_bytes_avoided"] += \
+            2 * n_rows * self._pages_per_slot * pg * row_bytes
+
     def _note_prefill_span(self, start: float) -> None:
         """prefill_s accumulates a UNION of dispatch→sync spans: two
         bucket groups dispatched back-to-back and collected after the
@@ -1913,7 +1979,8 @@ class Engine:
         fn = self._prefill_cache.get("spec")
         if fn is None:
             verify_fn = self._spec_verify_fn
-            paged = self.config.kv_layout == "paged"
+            paged = self.config.kv_layout == "paged" \
+                and not self._native_verify
             if paged:
                 from ..ops.paged_kv import gather_view, scatter_decode
 
@@ -1936,7 +2003,24 @@ class Engine:
                                       top_ps, top_ks)
                 return accepted, bonus
 
-            if paged:
+            if self._native_verify:
+                # native paged verify: the model writes the fed rows
+                # through the tables and attends with the ragged chunk
+                # kernel — verify reads only the pages each row's
+                # history + draft window spans, no dense view
+                native_verify = self._paged_verify_fn
+
+                def fused(params, tokens, kc, vc, tables, offsets,
+                          chunk_lens, step, temps, top_ps, top_ks,
+                          rng_key):
+                    logits, kc, vc = native_verify(
+                        params, tokens, kc, vc, tables, offsets,
+                        chunk_lens)
+                    accepted, bonus = _accept_and_bonus(
+                        logits, tokens, chunk_lens, step, temps,
+                        top_ps, top_ks, rng_key)
+                    return accepted, bonus, kc, vc
+            elif paged:
                 def fused(params, tokens, kc, vc, tables, offsets,
                           chunk_lens, step, temps, top_ps, top_ks,
                           rng_key):
@@ -2049,6 +2133,8 @@ class Engine:
             jnp.asarray(top_ks), self._prefill_base_key)
         accepted = np.asarray(accepted_dev)
         bonus = np.asarray(bonus_dev)
+        if self._native_verify:
+            self._note_view_avoided(b)
         self._note_pass("spec_passes", start)
         for i, req in enumerate(self.active):
             if req is None or req.pending_prefill:
@@ -2225,6 +2311,11 @@ def _sample_batch(logits: jnp.ndarray, key: jax.Array,
     bound = min(TOPK_BOUND, logits.shape[-1])
 
     def _greedy(_):
+        # tie-break assumption: argmax here and idx[:, 0] from the
+        # mixed branch's lax.top_k both resolve exact logit ties to
+        # the LOWEST index in XLA — if either ever changes, the same
+        # greedy row could emit different tokens depending on whether
+        # a batchmate samples (ADVICE r5)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     def _full(_):

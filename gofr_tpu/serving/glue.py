@@ -131,13 +131,17 @@ def llama_engine(params: Any, model_config: LlamaConfig,
         return kc, vc
 
     paged_decode_fn = None
+    paged_chunk_fn = None
+    paged_verify_fn = None
     if engine_config.kv_layout == "paged" and mesh is None:
-        # native paged decode: rows written through the block table,
-        # ragged paged-attention kernel reads pages in place — no
-        # per-pass view materialisation. (The mesh path keeps the
-        # view: the kernel is single-device; tp-sharding it is future
-        # work and the view path already shards.)
-        from ..models.llama import llama_decode_step_paged
+        # native paged serving: rows written through the block table,
+        # ragged paged-attention kernels read pages in place — no
+        # per-pass view materialisation on decode, chunked prefill,
+        # prefix reattachment or speculative verify. (The mesh path
+        # keeps the view: the kernels are single-device; tp-sharding
+        # them is future work and the view path already shards.)
+        from ..models.llama import (llama_decode_step_paged,
+                                    llama_prefill_chunk_paged)
         impl = {"kernel": "pallas", "interpret": "interpret",
                 "xla": "xla"}.get(engine_config.paged_attention, "auto")
 
@@ -147,11 +151,26 @@ def llama_engine(params: Any, model_config: LlamaConfig,
                                            v_pool, tables, lengths, c,
                                            implementation=impl)
 
+        def paged_chunk_fn(params, tokens, k_pool, v_pool, tables,
+                           offsets, chunk_lengths):
+            return llama_prefill_chunk_paged(
+                params, tokens, k_pool, v_pool, tables, offsets,
+                chunk_lengths, c, implementation=impl)
+
+        def paged_verify_fn(params, tokens, k_pool, v_pool, tables,
+                            offsets, chunk_lengths):
+            return llama_prefill_chunk_paged(
+                params, tokens, k_pool, v_pool, tables, offsets,
+                chunk_lengths, c, implementation=impl,
+                return_all_logits=True)
+
     return Engine(params, engine_config, prefill_fn=prefill_fn,
                   decode_fn=decode_fn, make_cache=make_cache,
                   prefill_chunk_fn=prefill_chunk_fn,
                   spec_verify_fn=spec_verify_fn,
                   paged_decode_fn=paged_decode_fn,
+                  paged_chunk_fn=paged_chunk_fn,
+                  paged_verify_fn=paged_verify_fn,
                   metrics=metrics, logger=logger)
 
 

@@ -7,7 +7,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from gofr_tpu.ops.paged_kv import gather_view, scatter_decode, scatter_prefill
+from gofr_tpu.ops.paged_kv import (gather_view, scatter_chunk,
+                                   scatter_decode, scatter_prefill)
 
 L, NP, PG, H, D = 2, 6, 4, 2, 3   # layers, pages, page size, heads, head dim
 
@@ -46,6 +47,68 @@ def test_scatter_prefill_dummy_row_dropped():
     slab = jnp.ones((L, 1, 4, H, D), jnp.float32)
     pool = scatter_prefill(pool, tables, slab)
     assert (np.asarray(pool) == -1.0).all()
+
+
+def test_scatter_chunk_writes_only_chunk_rows():
+    pool = _pool(-1.0)
+    tables = jnp.asarray([[3, 1, NP]], jnp.int32)
+    # chunk of 3 rows starting at logical position 3: spans the page
+    # boundary (page 3 offset 3, then page 1 offsets 0-1)
+    slab = jnp.zeros((L, 1, 8, H, D), jnp.float32)
+    slab = slab.at[:, 0, 0].set(7.0).at[:, 0, 1].set(8.0) \
+        .at[:, 0, 2].set(9.0)
+    pool = scatter_chunk(pool, tables, slab, jnp.asarray([3]),
+                         jnp.asarray([3]))
+    got = np.asarray(pool)
+    assert (got[:, :, 3, 3] == 7.0).all()
+    assert (got[:, :, 1, 0] == 8.0).all()
+    assert (got[:, :, 1, 1] == 9.0).all()
+    # rows 3..7 of the slab are past chunk_len: dropped, not written
+    written = np.zeros_like(got, bool)
+    written[:, :, 3, 3] = written[:, :, 1, 0] = written[:, :, 1, 1] = True
+    assert (got[~written] == -1.0).all()
+
+
+def test_scatter_chunk_matches_prefill_on_prompt_rows():
+    """With offset 0 and chunk_len = prompt length, scatter_chunk and
+    scatter_prefill agree on every prompt row; only the padding rows
+    within the last allocated page differ (chunk drops them)."""
+    tables = jnp.asarray([[2, 0, NP]], jnp.int32)
+    slab = jnp.arange(L * 1 * 8 * H * D, dtype=jnp.float32).reshape(
+        L, 1, 8, H, D)
+    a = scatter_prefill(_pool(), tables, slab)
+    b = scatter_chunk(_pool(), tables, slab, jnp.asarray([0]),
+                      jnp.asarray([6]))
+    view_a = gather_view(a, tables)
+    view_b = gather_view(b, tables)
+    np.testing.assert_array_equal(np.asarray(view_a[:, :, :6]),
+                                  np.asarray(view_b[:, :, :6]))
+    # rows 6,7 were pad rows: prefill wrote them, chunk dropped them
+    assert (np.asarray(view_b[:, :, 6:8]) == 0.0).all()
+    assert not (np.asarray(view_a[:, :, 6:8]) == 0.0).all()
+
+
+def test_scatter_chunk_dummy_row_dropped():
+    pool = _pool(-1.0)
+    tables = jnp.asarray([[NP, NP, NP]], jnp.int32)
+    slab = jnp.ones((L, 1, 4, H, D), jnp.float32)
+    pool = scatter_chunk(pool, tables, slab, jnp.asarray([0]),
+                         jnp.asarray([4]))
+    assert (np.asarray(pool) == -1.0).all()
+
+
+def test_scatter_chunk_past_table_end_drops():
+    pool = _pool(-1.0)
+    tables = jnp.asarray([[0, 1, 2]], jnp.int32)   # 12 logical rows
+    slab = jnp.zeros((L, 1, 4, H, D), jnp.float32)
+    pool = scatter_chunk(pool, tables, slab, jnp.asarray([11]),
+                         jnp.asarray([4]))
+    got = np.asarray(pool)
+    # position 11 lands (page 2, offset 3); 12..14 drop
+    assert (got[:, :, 2, 3] == 0.0).all()
+    untouched = np.full_like(got, -1.0)
+    untouched[:, :, 2, 3] = 0.0
+    np.testing.assert_array_equal(got, untouched)
 
 
 def test_scatter_decode_writes_k_rows():
