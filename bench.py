@@ -151,6 +151,32 @@ def run_scenario(engine_cfg, prompts, gen_len, warm_lens,
     return reqs, wall, stats
 
 
+def lat_stats(reqs):
+    # p50/p95 TTFT and TPOT (per-request mean inter-token latency) in
+    # ms for a finished scenario -- the perf trajectory tracks latency,
+    # not just tok/s. (No triple-quoted docstring: this function lives
+    # inside the BENCH_CODE string literal.)
+    ok = [r for r in reqs if r.error is None]
+    ttfts = sorted(r.ttft_ms for r in ok if r.ttft_ms is not None)
+    tpots = sorted((r.finished_at - r.first_token_at) * 1000.0
+                   / (len(r.generated) - 1)
+                   for r in ok
+                   if r.first_token_at is not None
+                   and r.finished_at is not None
+                   and len(r.generated) > 1)
+
+    def pct(values, p):
+        if not values:
+            return -1.0
+        return round(values[min(len(values) - 1,
+                                int(p * len(values)))], 2)
+
+    return {"p50_ttft_ms": pct(ttfts, 0.50),
+            "p95_ttft_ms": pct(ttfts, 0.95),
+            "p50_tpot_ms": pct(tpots, 0.50),
+            "p95_tpot_ms": pct(tpots, 0.95)}
+
+
 base_cfg = EngineConfig(max_batch=max_batch, max_seq=model_config.max_seq,
                         prefill_buckets=(64, 128, 256, 512), seed=0,
                         # prompt 64 + gen 32 keeps every live row under
@@ -248,6 +274,8 @@ try:
     tok1 = sum(len(r.generated) for r in ok1) / d1_wall
     decode_payload = {
         "config": f"max_batch={dec_batch}, K=1, greedy, gen={dec_gen}",
+        "latency_fused": lat_stats(d8),
+        "latency_single": lat_stats(d1),
         "tok_per_s_fused_m8": round(tok8, 1),
         "tok_per_s_single": round(tok1, 1),
         "multi_pass_speedup": round(tok8 / tok1, 3),
@@ -303,6 +331,7 @@ def prefill_run(mode):
     return ([r.generated for r in ok],
             {"prefill_tok_per_s": round(ptoks / max(stats["prefill_s"],
                                                     1e-9), 1),
+             "latency": lat_stats(reqs),
              "p50_ttft_ms": round(statistics.median(ttfts), 1),
              "prefill_calls": stats["prefill_calls"],
              "prefill_s": round(stats["prefill_s"], 3),
@@ -380,6 +409,7 @@ try:
     prod_payload = {
         "req_per_s": round(len(pok) / pwall, 2),
         "tok_per_s": round(ptok / pwall, 1),
+        "latency": lat_stats(preqs),
         "p50_ttft_ms": round(statistics.median(pttfts), 1) if pttfts else -1.0,
         "n_requests": prod_n,
         "config": "paged+prefix+spec+pipeline, max_batch=16",
@@ -406,6 +436,7 @@ print("BENCH_JSON " + json.dumps({
     "vs_baseline": round(req_per_s / 2000.0, 4),
     "tok_per_s": round(tok_per_s, 1),
     "p50_ttft_ms": round(p50_ttft, 1),
+    "latency": lat_stats(reqs),
     "mfu": mfu,
     "roofline_tok_per_s": round(roof, 1) if roof else None,
     "pct_of_roofline": round(100 * tok_per_s / roof, 1) if roof else None,

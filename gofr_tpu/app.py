@@ -258,7 +258,12 @@ class App:
         else:
             engine.metrics = self.container.metrics
         engine.logger = self.logger
+        # request tracing: the engine assembles engine.* child spans of
+        # the submitting request's HTTP/gRPC span through this tracer
+        if getattr(engine, "tracer", None) is None:
+            engine.tracer = self.container.tracer
         self.container.add_model(name, engine)
+        self._install_debug_routes()
         if self.container.tpu is None:
             from .device import DeviceRegistry
             self.container.tpu = DeviceRegistry(
@@ -275,6 +280,66 @@ class App:
         # a wedged device call must only hold it for close()'s short
         # join budget, not stop()'s full 30s
         self.on_shutdown(engine.close)
+
+    def _install_debug_routes(self) -> None:
+        """Serving debug surface, registered once with the first
+        ``serve_model``: ``GET /debug/engine`` (flight-recorder pass
+        ring + request logs + stats for every served model) and, when
+        ``PROFILER_ENABLED`` is set, ``POST /debug/profile/start|stop``
+        wrapping ``jax.profiler`` for on-demand xprof captures. Both
+        ride the normal middleware chain, so auth providers installed
+        on the app guard them like any other route."""
+        if getattr(self, "_debug_routes_installed", False):
+            return
+        self._debug_routes_installed = True
+        container = self.container
+
+        def engine_debug(ctx):
+            try:
+                n = int(ctx.param("n") or 0)
+            except (TypeError, ValueError):
+                n = 0
+            out = {}
+            for model_name, engine in container.models.items():
+                recorder = getattr(engine, "recorder", None)
+                out[model_name] = {
+                    "health": engine.health_check()
+                    if hasattr(engine, "health_check") else {},
+                    "stats": dict(getattr(engine, "stats", {})),
+                    "flight": recorder.snapshot(n or None)
+                    if recorder is not None else None,
+                }
+            return out
+        self.get("/debug/engine", engine_debug)
+
+        enabled = self.config.get_bool("PROFILER_ENABLED", False) \
+            if hasattr(self.config, "get_bool") else False
+        if not enabled:
+            return
+        from .serving.observability import ProfilerCapture
+        capture = ProfilerCapture(
+            base_dir=self.config.get_or_default(
+                "PROFILER_DIR", "/tmp/gofr_tpu_profiles"),
+            logger=self.logger)
+        self.profiler = capture
+
+        def profile_start(ctx):
+            try:
+                body = ctx.bind() or {}
+            except Exception:
+                body = {}
+            target = body.get("dir") if isinstance(body, dict) else None
+            return capture.start(target)
+
+        def profile_stop(ctx):
+            return capture.stop()
+
+        def profile_status(ctx):
+            return capture.status()
+
+        self.post("/debug/profile/start", profile_start)
+        self.post("/debug/profile/stop", profile_stop)
+        self.get("/debug/profile", profile_status)
 
     # ---------------------------------------------------------- lifecycle
     def _build_http_handler(self):

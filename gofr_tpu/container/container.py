@@ -209,9 +209,21 @@ class Container:
         m.new_histogram("app_tpu_execute_seconds", "device execute wall time",
                         buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
                                  0.05, 0.1, 0.25, 0.5, 1, 5))
+        latency_buckets = (0.005, 0.01, 0.025, 0.05, 0.075, 0.1, 0.15,
+                           0.25, 0.5, 1, 2, 5)
         m.new_histogram("app_chat_ttft_seconds", "time to first token",
-                        buckets=(0.005, 0.01, 0.025, 0.05, 0.075, 0.1, 0.15,
-                                 0.25, 0.5, 1, 2, 5))
+                        buckets=latency_buckets)
+        m.new_histogram("app_chat_queue_seconds",
+                        "submit -> first slot assignment (admission "
+                        "queue wait)", buckets=latency_buckets)
+        m.new_histogram("app_chat_e2e_seconds",
+                        "submit -> finish wall time",
+                        buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1,
+                                 2.5, 5, 10, 30, 60))
+        m.new_histogram("app_chat_tpot_seconds",
+                        "per-request mean inter-token latency",
+                        buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01,
+                                 0.025, 0.05, 0.1, 0.25, 0.5, 1))
 
     # ------------------------------------------------------------- health
     def health(self) -> dict[str, Any]:

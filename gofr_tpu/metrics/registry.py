@@ -112,12 +112,17 @@ class Histogram(_Metric):
             self._hist[key] = (counts, total + value, n + 1)
 
     def get_count(self, **labels: str) -> int:
-        entry = self._hist.get(_labels_key(labels))
-        return entry[2] if entry else 0
+        # under _lock: a concurrent record() replaces the entry tuple
+        # and mutates the bucket list in place — an unlocked read can
+        # observe a half-updated (counts, sum, n) triple
+        with self._lock:
+            entry = self._hist.get(_labels_key(labels))
+            return entry[2] if entry else 0
 
     def get_sum(self, **labels: str) -> float:
-        entry = self._hist.get(_labels_key(labels))
-        return entry[1] if entry else 0.0
+        with self._lock:
+            entry = self._hist.get(_labels_key(labels))
+            return entry[1] if entry else 0.0
 
     def render(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.description}"

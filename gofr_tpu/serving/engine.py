@@ -90,6 +90,13 @@ class GenRequest:
     prefill_epoch: int = 0         # bumps per batch-prefill dispatch so
                                    # a stale in-flight result can never
                                    # attach to a requeued request
+    # -- observability (host-side only; see serving/observability.py)
+    trace: Any = None              # (trace_id, parent_span_id) when the
+                                   # submitter's trace is sampled — the
+                                   # engine.* spans assemble at retire
+    admitted_at: float | None = None  # first slot assignment (queue end)
+    events: list = field(default_factory=list)  # (name, t0, t1, attrs)
+    _obs_done: bool = False        # finalize-once guard (retire + fail)
 
     def _emit(self, token: int | None) -> None:
         if self.out_queue is not None and self.loop is not None:
@@ -233,6 +240,15 @@ class EngineConfig:
     #: adaptive-pipelining threshold (``pipeline_depth=None`` only):
     #: minimum actively-decoding slots before a pass is left in flight.
     pipeline_min_slots: int = 8
+    #: flight recorder ring size: per-pass records (kind, occupancy,
+    #: queue depth, tokens, dispatch/collect spans, h2d count,
+    #: preemptions) kept in a fixed ring, served at ``/debug/engine``,
+    #: summarized by ``health_check()`` and dumped on a loop crash.
+    #: Recording is append-only host work — zero device perturbation.
+    #: 0 disables.
+    flight_recorder_size: int = 256
+    #: retired-request event logs kept alongside the pass ring
+    flight_recorder_requests: int = 32
 
 
 class Engine:
@@ -254,11 +270,24 @@ class Engine:
                  paged_chunk_fn: Callable | None = None,
                  paged_verify_fn: Callable | None = None,
                  metrics: Any = None,
-                 logger: Any = None) -> None:
+                 logger: Any = None, tracer: Any = None) -> None:
         self.params = params
         self.config = config
         self.metrics = metrics
         self.logger = logger
+        #: tracer for engine.* request spans (assembled at retire from
+        #: host timestamps); None = no spans. ``app.serve_model`` wires
+        #: the container's tracer here.
+        self.tracer = tracer
+        from .observability import FlightRecorder
+        self.recorder = FlightRecorder(config.flight_recorder_size,
+                                       config.flight_recorder_requests)
+        #: MFU basis, derived once at compile time in warmup() from the
+        #: decode graph's cost_analysis — None until then (gauge stays 0)
+        self._flops_per_token: float | None = None
+        self._peak_flops: float | None = None
+        self._gauge_wall = time.time()
+        self._gauge_tokens = 0
         self._make_cache = make_cache
         # chunked prefill: long prompts in bucket-width chunks against
         # the growing cache (slot layout slices the cache; the paged
@@ -596,7 +625,8 @@ class Engine:
                       "view_bytes_avoided": 0,
                       "prefix_hits": 0, "spec_passes": 0,
                       "spec_accepted": 0, "spec_drafted": 0,
-                      "spec_rows": 0}
+                      "spec_rows": 0, "preemptions": 0,
+                      "requeues": 0, "prefix_evictions": 0}
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> None:
@@ -684,6 +714,8 @@ class Engine:
             out["stalled_for_s"] = round(stalled_for, 1)
         if self._failed:
             out["error"] = self._failed
+        if self.recorder.enabled:
+            out["flight"] = self.recorder.summary()
         return out
 
     def close(self) -> None:
@@ -699,16 +731,68 @@ class Engine:
         manager post-hoc; a bare assignment would leave every
         ``set_gauge`` logging 'not registered')."""
         self.metrics = metrics
-        if metrics.get("app_engine_active_slots") is None:
-            metrics.new_gauge("app_engine_active_slots",
-                              "occupied decode slots")
-            metrics.new_gauge("app_engine_waiting",
-                              "requests queued for admission")
-        if metrics.get("app_engine_h2d_transfers") is None:
-            metrics.new_counter(
-                "app_engine_h2d_transfers",
-                "host->device scheduler-state uploads by the decode "
-                "path (event-driven; zero per steady-state pass)")
+        for name, desc in (
+            ("app_engine_active_slots", "occupied decode slots"),
+            ("app_engine_waiting", "requests queued for admission"),
+            ("app_engine_kv_pool_utilization",
+             "fraction of KV capacity in use (slots + prefix cache)"),
+            ("app_engine_kv_pool_fragmentation",
+             "fraction of allocated KV page capacity holding no rows"),
+            ("app_engine_prefix_cache_entries",
+             "prefix-cache entries pinned"),
+            ("app_engine_prefix_cache_pages",
+             "page references pinned by the prefix cache"),
+            ("app_engine_tokens_per_second",
+             "generated tokens per second (quarter-second window)"),
+            ("app_engine_mfu",
+             "decode-path model FLOPs utilization (cost_analysis FLOPs "
+             "x tokens/s over the chip peak; 0 when the peak or the "
+             "compiled cost is unknown)"),
+        ):
+            if metrics.get(name) is None:
+                metrics.new_gauge(name, desc)
+        for name, desc in (
+            ("app_engine_h2d_transfers",
+             "host->device scheduler-state uploads by the decode "
+             "path (event-driven; zero per steady-state pass)"),
+            ("app_engine_preemptions",
+             "requests preempted (vLLM-style recompute requeue)"),
+            ("app_engine_prefix_evictions",
+             "prefix-cache entries evicted under pool pressure"),
+            ("app_engine_requeues",
+             "admitted work bounced back to the requeue list "
+             "(chunk-walk pacing, slot races, preemption)"),
+            ("app_engine_spec_drafted",
+             "draft tokens offered to speculative verify"),
+            ("app_engine_spec_accepted",
+             "draft tokens accepted by speculative verify"),
+        ):
+            if metrics.get(name) is None:
+                metrics.new_counter(name, desc)
+        ttft_buckets = (0.005, 0.01, 0.025, 0.05, 0.075, 0.1, 0.15,
+                        0.25, 0.5, 1, 2, 5)
+        for name, desc, buckets in (
+            ("app_chat_ttft_seconds", "time to first token",
+             ttft_buckets),
+            ("app_chat_queue_seconds",
+             "submit -> first slot assignment (admission queue wait)",
+             ttft_buckets),
+            ("app_chat_e2e_seconds", "submit -> finish wall time",
+             (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60)),
+            ("app_chat_tpot_seconds",
+             "per-request mean inter-token latency (time per output "
+             "token past the first)",
+             (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+              0.25, 0.5, 1)),
+            ("app_engine_batch_occupancy",
+             "active decode slots per pass",
+             (1, 2, 4, 8, 16, 32, 64, 128, 256)),
+            ("app_tpu_execute_seconds", "device execute wall time",
+             (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+              0.25, 0.5, 1, 5)),
+        ):
+            if metrics.get(name) is None:
+                metrics.new_histogram(name, desc, buckets=buckets)
 
     def warmup(self, prompt_lens: tuple = (1,), decode: bool = True,
                chunked: bool = False) -> None:
@@ -754,6 +838,26 @@ class Engine:
                     jnp.zeros(b, jnp.float32), jnp.ones(b, jnp.float32),
                     jnp.zeros(b, jnp.int32), self._dev_decode_key)
                 jax.block_until_ready(toks)
+            # MFU basis: ONE cost_analysis of the (already compiled)
+            # decode graph, here at compile time — serve-time MFU gauge
+            # updates are pure host arithmetic, never a device sync
+            try:
+                from .observability import (device_peak_flops,
+                                            jit_cost_flops)
+                pass_flops = jit_cost_flops(
+                    self._decode, self.params, jnp.zeros(b, jnp.int32),
+                    jnp.zeros(b, bool), self._dev_zero,
+                    self.k_cache, self.v_cache, *tables,
+                    jnp.ones(b, jnp.int32), jnp.zeros(b, bool),
+                    jnp.zeros((), jnp.int32),
+                    jnp.zeros(b, jnp.float32), jnp.ones(b, jnp.float32),
+                    jnp.zeros(b, jnp.int32), self._dev_decode_key)
+                if pass_flops:
+                    self._flops_per_token = pass_flops / float(
+                        b * self._tokens_per_pass)
+                self._peak_flops = device_peak_flops()
+            except Exception:  # cost analysis is best-effort, never fatal
+                pass
         if chunked and self._prefill_chunk_fn is not None:
             # compile the chunk-walk graph at every bucket width for
             # both group sizes the walk uses (solo and full wave) —
@@ -805,13 +909,30 @@ class Engine:
 
     # -------------------------------------------------------------- submit
     def submit(self, prompt_tokens: list[int],
-               params: SamplingParams | None = None) -> GenRequest:
+               params: SamplingParams | None = None, *,
+               traceparent: str | None = None) -> GenRequest:
         """Called from the asyncio loop; returns a request whose
-        ``out_queue`` yields token ids and then ``None``."""
+        ``out_queue`` yields token ids and then ``None``.
+
+        When a tracer is attached, the request carries the caller's
+        trace identity — the active span on the submitting thread/task
+        (the HTTP/gRPC middleware span), else a W3C ``traceparent``
+        header — and the engine.* child spans assemble at retire."""
         params = params or SamplingParams()
         prompt_tokens = self._clamp_prompt(list(prompt_tokens),
                                            params.max_new_tokens)
         req = GenRequest(prompt_tokens=prompt_tokens, params=params)
+        if self.tracer is not None:
+            parent = self.tracer.current_span()
+            if parent is not None:
+                if parent.sampled:
+                    req.trace = (parent.trace_id, parent.span_id)
+            elif traceparent:
+                from ..tracing.tracer import (_traceparent_sampled,
+                                              extract_traceparent)
+                remote = extract_traceparent(traceparent)
+                if remote is not None and _traceparent_sampled(traceparent):
+                    req.trace = remote
         try:
             req.loop = asyncio.get_running_loop()
             req.out_queue = asyncio.Queue()
@@ -1078,6 +1199,7 @@ class Engine:
             self.active[slot] = req
             req.slot = slot
             req.pending_prefill = True
+            self._note_admitted(req)
             if paged and req.admit_order < 0:
                 req.admit_order = self._admit_seq
                 self._admit_seq += 1
@@ -1166,6 +1288,8 @@ class Engine:
                                                 width)
                         call = (self._get_chunk_prefill(cw) if cw
                                 else fn)
+                        c0 = time.perf_counter()
+                        w0 = time.time()
                         toks, self.k_cache, self.v_cache = call(
                             self.params, jnp.asarray(tokens),
                             self.k_cache, self.v_cache,
@@ -1177,6 +1301,20 @@ class Engine:
                         self.stats["prefill_calls"] += 1
                         if self._native_chunk:
                             self._note_view_avoided(G)
+                        if self.recorder.enabled:
+                            self.recorder.record_pass(
+                                "prefill_chunk", rows=len(ready),
+                                width=width,
+                                dur=round(time.perf_counter() - c0, 6),
+                                view_avoided=self._native_chunk,
+                                queue_depth=self.waiting.qsize())
+                        w1 = time.time()
+                        for r in ready:
+                            self._req_event(
+                                r, "prefill", w0, w1,
+                                {"bucket": width,
+                                 "offset": int(r.prefill_offset),
+                                 "view_avoided": self._native_chunk})
                         toks_np = None
                         for row, r in enumerate(ready):
                             r.prefill_offset += int(lens[row])
@@ -1226,6 +1364,9 @@ class Engine:
         while len(self._free_pages) < pages_needed and self._prefix_cache:
             key = next(iter(self._prefix_cache))
             pages = self._prefix_cache.pop(key)
+            self.stats["prefix_evictions"] += 1
+            if self.metrics is not None:
+                self.metrics.increment_counter("app_engine_prefix_evictions")
             count = self._prefix_lens.get(len(key), 0) - 1
             if count > 0:
                 self._prefix_lens[len(key)] = count
@@ -1334,6 +1475,12 @@ class Engine:
         req = self.active[slot]
         if req is None:
             return
+        self.stats["preemptions"] += 1
+        if self.metrics is not None:
+            self.metrics.increment_counter("app_engine_preemptions")
+        _now = time.time()
+        self._req_event(req, "preempt", _now, _now,
+                        {"slot": slot, "generated": len(req.generated)})
         # the request re-enters by recompute with host-side state; a
         # surviving _dev_last entry from its old life in this slot must
         # never match it again (its generated[] diverges from the
@@ -1387,6 +1534,9 @@ class Engine:
         if id(req) not in self._requeued_set:
             self._requeued_set.add(id(req))
             self._requeued.append(req)
+            self.stats["requeues"] += 1
+            if self.metrics is not None:
+                self.metrics.increment_counter("app_engine_requeues")
 
     def _alloc_pool(self, page: int):
         """Allocate the head-major paged pool [L, Hkv, Np, pg, hd]
@@ -1440,9 +1590,57 @@ class Engine:
             self.k_cache, self.v_cache = self._make_cache(
                 cfg.max_batch, cfg.max_seq)
 
+    def _req_event(self, req: GenRequest, name: str, t0: float,
+                   t1: float, attrs: dict | None = None) -> None:
+        """Append a lifecycle event (bounded) — spans and the flight
+        recorder's request log assemble from these at retire."""
+        if len(req.events) < 64:
+            req.events.append((name, t0, t1, attrs or {}))
+
+    def _note_admitted(self, req: GenRequest) -> None:
+        """First slot assignment: the queue span ends here. Recompute
+        re-admissions (preemption, pool-exhaustion restarts) keep the
+        original admission time — the queue wait was paid once."""
+        if req.admitted_at is None:
+            now = time.time()
+            req.admitted_at = now
+            if self.metrics is not None:
+                self.metrics.record_histogram(
+                    "app_chat_queue_seconds", now - req.submitted_at)
+
+    def _finalize_obs(self, req: GenRequest) -> None:
+        """Terminal observability for a request (exactly once): latency
+        histograms, the flight-recorder request log, and the engine.*
+        span assembly. All host arithmetic over timestamps already
+        collected — called before the terminal None is emitted so a
+        drained stream implies the spans are exported."""
+        if req._obs_done:
+            return
+        req._obs_done = True
+        end = req.finished_at or time.time()
+        if self.metrics is not None and req.error is None \
+                and not req.cancelled:
+            self.metrics.record_histogram("app_chat_e2e_seconds",
+                                          end - req.submitted_at)
+            n = len(req.generated)
+            if req.first_token_at is not None and n > 1:
+                self.metrics.record_histogram(
+                    "app_chat_tpot_seconds",
+                    (end - req.first_token_at) / (n - 1))
+        if self.recorder.enabled:
+            from .observability import request_summary
+            self.recorder.record_request(request_summary(req))
+        if self.tracer is not None and req.trace is not None:
+            try:
+                from .observability import emit_engine_spans
+                emit_engine_spans(self.tracer, req)
+            except Exception:  # tracing must never take down a stream
+                pass
+
     def _fail(self, req: GenRequest, error: str) -> None:
         req.error = error
         req.finished_at = time.time()
+        self._finalize_obs(req)
         req._emit(None)
 
     def _admit_batch(self, reqs: list[GenRequest]) -> None:
@@ -1491,6 +1689,10 @@ class Engine:
                         self._attach_prefix(slot, req.prompt_tokens,
                                             covered)
                         req.prefill_offset = covered
+                        _now = time.time()
+                        self._req_event(req, "prefill", _now, _now,
+                                        {"prefix_hit": True,
+                                         "covered_rows": covered})
                         reserve_for_walk(req, slot)
                     continue
             if (self._prefill_chunk_fn is not None
@@ -1543,6 +1745,7 @@ class Engine:
             req.slot = slot
             self._dev_last_reqs[slot] = None  # fresh occupant: host token
             self.active[slot] = req       # reserve before the next scan
+            self._note_admitted(req)
             placed.append(req)
         if not placed:
             return
@@ -1605,6 +1808,8 @@ class Engine:
             "slots": [r.slot for r in placed],
             "epochs": [r.prefill_epoch for r in placed],
             "t0": start,
+            "wall0": time.time(),  # span timestamps use wall clock
+            "bucket": bucket,
         })
 
     def _collect_prefills(self) -> None:
@@ -1637,6 +1842,13 @@ class Engine:
                 continue
             self._note_prefill_span(rec["t0"])
             now = time.time()
+            if self.recorder.enabled:
+                self.recorder.record_pass(
+                    "prefill", rows=len(rec["placed"]),
+                    bucket=rec.get("bucket"),
+                    dur=round(time.perf_counter() - rec["t0"], 6),
+                    occupancy=sum(r is not None for r in self.active),
+                    queue_depth=self.waiting.qsize())
             for row, (req, slot, epoch) in enumerate(
                     zip(rec["placed"], rec["slots"], rec["epochs"])):
                 if (req.prefill_epoch != epoch
@@ -1644,6 +1856,9 @@ class Engine:
                         or req.finished_at is not None):
                     continue  # preempted/retired/re-admitted since
                 req.pending_prefill = False
+                self._req_event(req, "prefill", rec.get("wall0", now),
+                                now, {"bucket": rec.get("bucket"),
+                                      "rows": len(rec["placed"])})
                 first = int(toks_np[row])
                 if req.first_token_at is None:  # not a recompute
                     req.first_token_at = now
@@ -1727,6 +1942,8 @@ class Engine:
         self._dev_last_reqs[slot] = None  # device-token lineage ends here
         self._sched_dirty = True
         req.finished_at = time.time()
+        self._finalize_obs(req)  # before the terminal None: a drained
+        #                          stream implies spans are exported
         req._emit(None)
         self.active[slot] = None
         self.lengths[slot] = 0
@@ -1853,6 +2070,7 @@ class Engine:
         cfg = self.config
         T = self._tokens_per_pass
         paged = cfg.kv_layout == "paged"
+        h2d0 = self.stats["h2d_transfers"]  # this pass's upload delta
         # pre-pass sweep retires cancelled/at-ceiling slots, which
         # settles the pipeline per-slot via _retire
         self._retire_unservable()
@@ -1920,14 +2138,17 @@ class Engine:
             # device output next pass: their use_prev flips — one more
             # sync, then steady state
             self._sched_dirty = True
+        disp = time.perf_counter() - host0
         self._pending.append({
             "toks": step_tokens,
             "reqs": list(self.active),
             "mask": active_mask,
             "valid": valid,
             "t0": start,
+            "disp": disp,
+            "h2d": self.stats["h2d_transfers"] - h2d0,
         })
-        self.stats["dispatch_s"] += time.perf_counter() - host0
+        self.stats["dispatch_s"] += disp
 
     def _decode_collect(self) -> None:
         """Sync the oldest in-flight pass: emit its tokens, retire
@@ -1947,9 +2168,13 @@ class Engine:
         self._decode_busy_until = end
         self.stats["decode_passes"] += 1
         self.stats["decode_s"] += busy
+        occupancy = int(rec["mask"].sum())
         if self.metrics is not None:
             self.metrics.record_histogram("app_tpu_execute_seconds", busy)
+            self.metrics.record_histogram("app_engine_batch_occupancy",
+                                          float(occupancy))
         self._step_count += 1
+        emitted = 0
         for i, req in enumerate(rec["reqs"]):
             if req is None or not rec["mask"][i]:
                 continue
@@ -1961,12 +2186,25 @@ class Engine:
                 req.generated.append(token)
                 req._emit(token)
                 self.total_generated += 1
+                emitted += 1
                 if self._finished(req, token):
                     done = True
                     break
             if done or rec["valid"][i] < self._tokens_per_pass:
                 self._retire(i)
-        self.stats["collect_s"] += time.perf_counter() - end
+        collect = time.perf_counter() - end
+        self.stats["collect_s"] += collect
+        if self.recorder.enabled:
+            # the pass record: everything here is a host int/float the
+            # collect already computed — no device reads beyond the
+            # token sync that IS the collect
+            self.recorder.record_pass(
+                "decode", dur=round(busy, 6),
+                dispatch_s=round(rec.get("disp", 0.0), 6),
+                collect_s=round(collect, 6), occupancy=occupancy,
+                queue_depth=self.waiting.qsize(), tokens=emitted,
+                h2d=rec.get("h2d", 0),
+                preemptions=self.stats["preemptions"])
 
     # ------------------------------------------------- speculative decode
     def _get_spec_verify(self) -> Callable:
@@ -2124,6 +2362,7 @@ class Engine:
         tables = (self._tables_arg(),) if paged else ()
         self._rng_step += 1
         start = time.perf_counter()
+        w0 = time.time()
         fn = self._get_spec_verify()
         accepted_dev, bonus_dev, self.k_cache, self.v_cache = fn(
             self.params, jnp.asarray(tokens), self.k_cache,
@@ -2136,10 +2375,20 @@ class Engine:
         if self._native_verify:
             self._note_view_avoided(b)
         self._note_pass("spec_passes", start)
+        w1 = time.time()
+        pass_drafted = pass_accepted = pass_rows = 0
         for i, req in enumerate(self.active):
             if req is None or req.pending_prefill:
                 continue
             n_acc = int(accepted[i])
+            n_drafted = len(proposals.get(i, []))
+            pass_drafted += n_drafted
+            pass_accepted += n_acc
+            pass_rows += 1
+            if n_drafted:
+                self._req_event(req, "spec_verify", w0, w1,
+                                {"drafted": n_drafted,
+                                 "accepted": n_acc})
             emitted = proposals.get(i, [])[:n_acc] + [int(bonus[i])]
             self.stats["spec_accepted"] += n_acc
             # offered drafts this row — the honest acceptance-rate
@@ -2170,6 +2419,18 @@ class Engine:
             self.lengths[i] += kept
             if done or kept >= ceiling:
                 self._retire(i)
+        if self.metrics is not None and pass_drafted:
+            self.metrics.add_counter("app_engine_spec_drafted",
+                                     float(pass_drafted))
+            self.metrics.add_counter("app_engine_spec_accepted",
+                                     float(pass_accepted))
+        if self.recorder.enabled:
+            self.recorder.record_pass(
+                "spec_verify", rows=pass_rows, drafted=pass_drafted,
+                accepted=pass_accepted,
+                dur=round(time.perf_counter() - start, 6),
+                occupancy=pass_rows,
+                queue_depth=self.waiting.qsize())
 
     def _update_gauges(self) -> None:
         if self.metrics is None:
@@ -2179,6 +2440,43 @@ class Engine:
             float(sum(r is not None for r in self.active)))
         self.metrics.set_gauge("app_engine_waiting",
                                float(self.waiting.qsize()))
+        # derived gauges, throttled: pure host arithmetic over counters
+        # the loop already maintains — never a device sync
+        now = time.time()
+        dt = now - self._gauge_wall
+        if dt < 0.25:
+            return
+        m = self.metrics
+        tps = (self.total_generated - self._gauge_tokens) / dt
+        self._gauge_wall = now
+        self._gauge_tokens = self.total_generated
+        m.set_gauge("app_engine_tokens_per_second", round(tps, 2))
+        mfu = (tps * self._flops_per_token / self._peak_flops
+               if self._flops_per_token and self._peak_flops else 0.0)
+        m.set_gauge("app_engine_mfu", round(mfu, 6))
+        cfg = self.config
+        if cfg.kv_layout == "paged":
+            used = self._n_pages - len(self._free_pages)
+            m.set_gauge("app_engine_kv_pool_utilization",
+                        round(used / max(1, self._n_pages), 4))
+            # fragmentation: allocated page capacity not holding live
+            # rows (pending-prefill slots report their walk progress)
+            cap_rows = int(self._slot_pages.sum()) * cfg.page_size
+            live = int(self.lengths.sum()) + sum(
+                r.prefill_offset for r in self.active
+                if r is not None and r.pending_prefill)
+            frag = 1.0 - live / cap_rows if cap_rows else 0.0
+            m.set_gauge("app_engine_kv_pool_fragmentation",
+                        round(min(1.0, max(0.0, frag)), 4))
+            m.set_gauge("app_engine_prefix_cache_entries",
+                        float(len(self._prefix_cache)))
+            m.set_gauge("app_engine_prefix_cache_pages",
+                        float(self._cached_pages))
+        else:
+            m.set_gauge("app_engine_kv_pool_utilization",
+                        round(float(self.lengths.sum())
+                              / (cfg.max_batch * cfg.max_seq), 4))
+            m.set_gauge("app_engine_kv_pool_fragmentation", 0.0)
 
     # ---------------------------------------------------------------- loop
     def _loop(self) -> None:
@@ -2281,6 +2579,9 @@ class Engine:
         self._running = False
         if self.logger:
             self.logger.error(f"engine loop crashed: {exc!r}")
+            # post-mortem: the last N pass records tell you what the
+            # loop was doing when it died
+            self.recorder.dump(self.logger, reason=self._failed)
         self._shutdown_cleanup(f"engine crashed: {self._failed}")
 
 

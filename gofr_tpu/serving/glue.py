@@ -38,6 +38,7 @@ def llama_engine(params: Any, model_config: LlamaConfig,
                  engine_config: EngineConfig | None = None, *,
                  mesh: Any = None,
                  metrics: Any = None, logger: Any = None,
+                 tracer: Any = None,
                  implementation: str = "auto",
                  quantize: str | None = None) -> Engine:
     engine_config = engine_config or EngineConfig()
@@ -171,11 +172,12 @@ def llama_engine(params: Any, model_config: LlamaConfig,
                   paged_decode_fn=paged_decode_fn,
                   paged_chunk_fn=paged_chunk_fn,
                   paged_verify_fn=paged_verify_fn,
-                  metrics=metrics, logger=logger)
+                  metrics=metrics, logger=logger, tracer=tracer)
 
 
 def moe_engine(params: Any, model_config, engine_config: EngineConfig | None = None,
                *, metrics: Any = None, logger: Any = None,
+               tracer: Any = None,
                implementation: str = "auto") -> Engine:
     from ..models.moe import moe_decode_step, moe_prefill_last
     import jax.numpy as jnp
@@ -201,7 +203,7 @@ def moe_engine(params: Any, model_config, engine_config: EngineConfig | None = N
 
     return Engine(params, engine_config, prefill_fn=prefill_fn,
                   decode_fn=decode_fn, make_cache=make_cache,
-                  metrics=metrics, logger=logger)
+                  metrics=metrics, logger=logger, tracer=tracer)
 
 
 def demo_llama_engine(engine_config: EngineConfig | None = None,

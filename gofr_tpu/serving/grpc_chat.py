@@ -53,7 +53,11 @@ def make_chat_service(engine: Engine, tokenizer: Any) -> GRPCService:
             prompt, params = _params_from(request or {})
             prompt_tokens = tokenizer.encode(prompt)
             start = time.perf_counter()
-            req = engine.submit(prompt_tokens, params)
+            # the gRPC server's per-RPC span is active on this task;
+            # invocation metadata carries the raw header as fallback
+            req = engine.submit(prompt_tokens, params,
+                                traceparent=ctx.header("traceparent")
+                                or None)
             if req.error:
                 # admission refused: distinct status, not INTERNAL
                 exc = RuntimeError(req.error)
@@ -70,9 +74,17 @@ def make_chat_service(engine: Engine, tokenizer: Any) -> GRPCService:
                     # mid-generation failure (kv loss, shutdown): the
                     # client must not mistake truncation for completion
                     raise RuntimeError(f"generation failed: {req.error}")
+                tpot_ms = None
+                if (req.first_token_at is not None
+                        and req.finished_at is not None and n > 1):
+                    tpot_ms = round((req.finished_at - req.first_token_at)
+                                    * 1000.0 / (n - 1), 3)
                 yield {"done": True,
                        "usage": {"prompt_tokens": len(prompt_tokens),
                                  "completion_tokens": n,
+                                 "ttft_ms": round(req.ttft_ms, 2)
+                                 if req.ttft_ms else None,
+                                 "tpot_ms": tpot_ms,
                                  "duration_ms": round(
                                      (time.perf_counter() - start) * 1e3,
                                      2)}}
@@ -86,7 +98,9 @@ def make_chat_service(engine: Engine, tokenizer: Any) -> GRPCService:
         async def Complete(self, ctx, request) -> dict:
             prompt, params = _params_from(request or {})
             prompt_tokens = tokenizer.encode(prompt)
-            req = engine.submit(prompt_tokens, params)
+            req = engine.submit(prompt_tokens, params,
+                                traceparent=ctx.header("traceparent")
+                                or None)
             if req.error:
                 # same overload condition, same status as Stream
                 exc = RuntimeError(req.error)

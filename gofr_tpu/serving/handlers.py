@@ -47,7 +47,11 @@ def make_chat_handler(engine: Engine, tokenizer: Any):
         prompt_tokens = tokenizer.encode(prompt)
         stream = bool(body.get("stream", False))
 
-        req = engine.submit(prompt_tokens, params)
+        # the tracer middleware's span is active on this task, so the
+        # engine picks the parent from the contextvar; the raw header
+        # is the fallback for apps running without the middleware
+        req = engine.submit(prompt_tokens, params,
+                            traceparent=ctx.header("traceparent") or None)
         if req.error:
             # instant failure = admission refused, not a generation bug
             raise ErrorServiceUnavailable(req.error)
@@ -84,6 +88,11 @@ def make_chat_handler(engine: Engine, tokenizer: Any):
             tokens.append(token)
         if req.error:
             raise RuntimeError(f"generation failed: {req.error}")
+        tpot_ms = None
+        if (req.first_token_at is not None and req.finished_at is not None
+                and len(tokens) > 1):
+            tpot_ms = ((req.finished_at - req.first_token_at) * 1000.0
+                       / (len(tokens) - 1))
         return {
             "text": tokenizer.decode(tokens),
             "tokens": tokens,
@@ -91,6 +100,7 @@ def make_chat_handler(engine: Engine, tokenizer: Any):
                 "prompt_tokens": len(prompt_tokens),
                 "completion_tokens": len(tokens),
                 "ttft_ms": round(req.ttft_ms, 2) if req.ttft_ms else None,
+                "tpot_ms": round(tpot_ms, 3) if tpot_ms else None,
             },
         }
 

@@ -1,0 +1,252 @@
+"""Serving-path observability: flight recorder, engine trace assembly,
+on-demand profiler capture, MFU derivation.
+
+Everything in this module is HOST-side bookkeeping over timestamps and
+counters the engine already collects. The hard invariant is **zero
+perturbation of the hot path**: no device syncs, no host->device
+transfers, no blocking work on the decode dispatch/collect path. The
+pass ring is an append-only ``deque`` (CPython appends are atomic under
+the GIL — no lock on the writer side), spans are assembled *after* a
+request retires from timestamps recorded along the way, and the MFU
+gauge is derived once at compile time from the decode graph's
+``cost_analysis()`` FLOPs — serve-time updates are pure host
+arithmetic. The transfer-guard test (zero steady-state h2d) and the
+greedy bit-identity tests run with all of this enabled.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any
+
+
+class FlightRecorder:
+    """Fixed-size ring of per-pass records plus a short log of retired
+    requests' event trails — the engine's black box. Served as JSON at
+    ``/debug/engine``, summarized in ``Engine.health_check()``, dumped
+    through the logger when the hot loop crashes.
+
+    Writer side (the engine thread) only ever appends plain dicts to
+    bounded deques; reader side (``snapshot``) copies under the GIL.
+    ``size <= 0`` disables recording entirely.
+    """
+
+    def __init__(self, size: int = 256, request_logs: int = 32) -> None:
+        self.enabled = size > 0
+        self.size = max(0, int(size))
+        self._passes: deque = deque(maxlen=max(1, self.size))
+        self._requests: deque = deque(maxlen=max(1, int(request_logs)))
+        self._seq = 0
+        self._by_kind: dict[str, int] = {}
+
+    # ------------------------------------------------------------ writers
+    def record_pass(self, kind: str, **fields: Any) -> None:
+        if not self.enabled:
+            return
+        self._seq += 1
+        rec = {"seq": self._seq, "kind": kind, "t": time.time()}
+        rec.update(fields)
+        self._passes.append(rec)
+        self._by_kind[kind] = self._by_kind.get(kind, 0) + 1
+
+    def record_request(self, summary: dict) -> None:
+        if self.enabled:
+            self._requests.append(summary)
+
+    # ------------------------------------------------------------ readers
+    def snapshot(self, n: int | None = None) -> dict:
+        passes = list(self._passes)
+        if n is not None and n > 0:
+            passes = passes[-n:]
+        return {"enabled": self.enabled, "ring_size": self.size,
+                "passes_recorded": self._seq, "passes": passes,
+                "requests": list(self._requests)}
+
+    def summary(self) -> dict:
+        last = self._passes[-1] if self._passes else None
+        out = {"passes_recorded": self._seq, "by_kind": dict(self._by_kind)}
+        if last is not None:
+            out["last_pass_kind"] = last["kind"]
+            out["last_pass_age_s"] = round(time.time() - last["t"], 3)
+        return out
+
+    def dump(self, logger: Any, reason: str = "") -> None:
+        """Post-mortem: the ring is exactly what you want to see after
+        a crash — the last N passes before the loop died."""
+        if logger is None or not self.enabled:
+            return
+        try:
+            text = json.dumps(self.snapshot(), default=str)
+            logger.error(f"engine flight recorder ({reason or 'dump'}): "
+                         f"{text[:16384]}")
+        except Exception:
+            pass
+
+
+def request_summary(req: Any) -> dict:
+    """Flight-recorder entry for a retired request — plain host fields."""
+    return {
+        "prompt_tokens": len(req.prompt_tokens),
+        "generated": len(req.generated),
+        "slot": req.slot,
+        "submitted_at": req.submitted_at,
+        "admitted_at": req.admitted_at,
+        "first_token_at": req.first_token_at,
+        "finished_at": req.finished_at,
+        "ttft_ms": round(req.ttft_ms, 3) if req.ttft_ms is not None else None,
+        "error": req.error,
+        "cancelled": req.cancelled,
+        "events": [{"name": name, "t0": t0, "t1": t1, **(attrs or {})}
+                   for name, t0, t1, attrs in req.events],
+    }
+
+
+def emit_engine_spans(tracer: Any, req: Any) -> None:
+    """Assemble the ``engine.*`` child spans for a retired request and
+    export them through the tracer. Called once at retire, entirely from
+    host timestamps recorded along the lifecycle — the hot loop never
+    creates spans. ``req.trace`` carries (trace_id, parent_span_id)
+    captured at submit from the caller's active span (the HTTP/gRPC
+    middleware span) or the inbound ``traceparent``, so one distributed
+    trace runs HTTP -> engine -> retire."""
+    trace = getattr(req, "trace", None)
+    if tracer is None or trace is None:
+        return
+    trace_id, parent_id = trace
+    end = req.finished_at or time.time()
+    status = "OK" if req.error is None else f"ERROR: {req.error}"
+    root = tracer.emit_span(
+        "engine.request", trace_id=trace_id, parent_id=parent_id,
+        start_time=req.submitted_at, end_time=end, status=status,
+        attributes={"prompt_tokens": len(req.prompt_tokens),
+                    "generated_tokens": len(req.generated),
+                    "slot": req.slot, "cancelled": req.cancelled})
+    admit = req.admitted_at or req.first_token_at or end
+    tracer.emit_span("engine.queue", trace_id=trace_id,
+                     parent_id=root.span_id, start_time=req.submitted_at,
+                     end_time=admit)
+    for name, t0, t1, attrs in req.events:
+        tracer.emit_span(f"engine.{name}", trace_id=trace_id,
+                         parent_id=root.span_id, start_time=t0,
+                         end_time=t1, attributes=attrs)
+    if req.first_token_at is not None:
+        n = len(req.generated)
+        tpot = ((end - req.first_token_at) / (n - 1)) if n > 1 else None
+        tracer.emit_span(
+            "engine.decode", trace_id=trace_id, parent_id=root.span_id,
+            start_time=req.first_token_at, end_time=end,
+            attributes={"tokens": n,
+                        "tpot_s": round(tpot, 6) if tpot else None})
+    tracer.emit_span("engine.retire", trace_id=trace_id,
+                     parent_id=root.span_id, start_time=end, end_time=end,
+                     attributes={"error": req.error or ""})
+
+
+# ------------------------------------------------------------------- MFU
+#
+# Peak dense bf16 FLOPs per chip by device kind (same table the bench
+# uses). Unknown kinds (CPU, future TPUs) -> None and the MFU gauge
+# simply stays 0 — never a guess.
+TPU_PEAK_FLOPS = {"TPU v5 lite": 197e12, "TPU v5p": 459e12,
+                  "TPU v5": 459e12, "TPU v4": 275e12,
+                  "TPU v6 lite": 918e12}
+
+
+def device_peak_flops() -> float | None:
+    try:
+        import jax
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        return None
+    for name, peak in sorted(TPU_PEAK_FLOPS.items(),
+                             key=lambda kv: -len(kv[0])):
+        if kind.startswith(name):
+            return peak
+    return None
+
+
+def jit_cost_flops(jitted: Any, *args: Any) -> float | None:
+    """FLOPs of one call of a jitted function, from XLA's own cost
+    analysis of the lowered/compiled graph. Runs at compile time (the
+    engine calls it from ``warmup``), never on the serving path; every
+    failure mode degrades to None."""
+    try:
+        lowered = jitted.lower(*args)
+    except Exception:
+        return None
+    cost = None
+    for source in (lambda: lowered.cost_analysis(),
+                   lambda: lowered.compile().cost_analysis()):
+        try:
+            cost = source()
+        except Exception:
+            cost = None
+        if cost is not None:
+            break
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    if isinstance(cost, dict) and cost.get("flops"):
+        return float(cost["flops"])
+    return None
+
+
+# -------------------------------------------------------------- profiler
+class ProfilerCapture:
+    """On-demand TPU profiler capture wrapping
+    ``jax.profiler.start_trace/stop_trace`` with single-flight
+    semantics — the state machine behind ``POST /debug/profile/start``
+    and ``/debug/profile/stop``. A second start while a capture runs is
+    refused (JAX would raise); stop without a start reports cleanly."""
+
+    def __init__(self, base_dir: str = "/tmp/gofr_tpu_profiles",
+                 logger: Any = None) -> None:
+        self.base_dir = base_dir
+        self.logger = logger
+        self._lock = threading.Lock()
+        self._active_dir: str | None = None
+        self._started_at: float | None = None
+
+    def start(self, trace_dir: str | None = None) -> dict:
+        with self._lock:
+            if self._active_dir is not None:
+                return {"ok": False, "error": "capture already running",
+                        "dir": self._active_dir}
+            path = trace_dir or os.path.join(
+                self.base_dir, time.strftime("%Y%m%d-%H%M%S"))
+            try:
+                os.makedirs(path, exist_ok=True)
+                import jax
+                jax.profiler.start_trace(path)
+            except Exception as exc:
+                return {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
+            self._active_dir = path
+            self._started_at = time.time()
+            if self.logger:
+                self.logger.info(f"profiler capture started: {path}")
+            return {"ok": True, "dir": path}
+
+    def stop(self) -> dict:
+        with self._lock:
+            if self._active_dir is None:
+                return {"ok": False, "error": "no capture running"}
+            path, self._active_dir = self._active_dir, None
+            started, self._started_at = self._started_at, None
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception as exc:
+                return {"ok": False, "dir": path,
+                        "error": f"{type(exc).__name__}: {exc}"}
+            if self.logger:
+                self.logger.info(f"profiler capture stopped: {path}")
+            return {"ok": True, "dir": path,
+                    "duration_s": round(time.time() - started, 3)
+                    if started else None}
+
+    def status(self) -> dict:
+        return {"running": self._active_dir is not None,
+                "dir": self._active_dir}

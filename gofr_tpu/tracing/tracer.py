@@ -33,6 +33,14 @@ def _rand_hex(nbytes: int) -> str:
     return os.urandom(nbytes).hex()
 
 
+# Head-sampling decisions use a PRIVATE generator for the same reason
+# span ids use os.urandom: an application calling random.seed() (every
+# ML test setup does) must not make the sampling sequence — and thus
+# which requests get traced — deterministic and identical across
+# seeded workers.
+_sample_rng = random.Random(os.urandom(8))
+
+
 def extract_traceparent(header: str | None) -> tuple[str, str] | None:
     """Parse ``00-<trace-id>-<parent-id>-<flags>`` -> (trace_id, parent_id)."""
     if not header:
@@ -175,12 +183,32 @@ class Tracer:
             sampled = _traceparent_sampled(traceparent)
         else:
             trace_id, parent_id = _rand_hex(16), None
-            sampled = self.ratio >= 1.0 or random.random() < self.ratio
+            sampled = self.ratio >= 1.0 or _sample_rng.random() < self.ratio
         span = Span(name=name, trace_id=trace_id, span_id=_rand_hex(8),
                     parent_id=parent_id, start_time=time.time(), tracer=self,
                     sampled=sampled, attributes=dict(attributes or {}))
         span._ctx_token = _current_span.set(span)
         span._log_token = set_trace_context(span.trace_id, span.span_id)
+        return span
+
+    def emit_span(self, name: str, *, trace_id: str,
+                  parent_id: str | None = None, start_time: float,
+                  end_time: float, attributes: dict[str, Any] | None = None,
+                  status: str = "OK") -> Span:
+        """Build and export a FINISHED span from explicit timestamps.
+
+        The host-side assembly path used by the serving engine: spans
+        for a retired request are reconstructed after the fact from
+        timestamps the hot loop already collected, on the engine
+        thread — so this never touches the contextvar and never makes
+        a sampling decision (the caller only invokes it for sampled
+        traces)."""
+        span = Span(name=name, trace_id=trace_id, span_id=_rand_hex(8),
+                    parent_id=parent_id, start_time=start_time,
+                    tracer=self, sampled=True,
+                    attributes=dict(attributes or {}), status=status)
+        span.end_time = end_time
+        self._export(span)
         return span
 
     def inject_headers(self, headers: dict[str, str]) -> dict[str, str]:

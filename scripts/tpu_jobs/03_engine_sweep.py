@@ -37,6 +37,10 @@ from gofr_tpu.models.llama import LlamaConfig, llama_init, param_count
 from gofr_tpu.serving.engine import EngineConfig, SamplingParams
 from gofr_tpu.serving.glue import llama_engine
 
+# GOFR_JOB_PROFILE=1: xprof capture spanning the sweep points
+from _profiling import profile_start, profile_stop
+_trace_dir = profile_start("engine_sweep")
+
 DEV = jax.devices()[0].device_kind
 PEAK_FLOPS = {"TPU v5 lite": 197e12, "TPU v5": 459e12, "TPU v5p": 459e12,
               "TPU v4": 275e12, "TPU v6 lite": 918e12}
@@ -159,6 +163,8 @@ run_point(32, 8, "paged", paged_attention="kernel", quantize="int8")
 # int4: a quarter of the weight stream — the aggressive roofline point
 run_point(32, 8, "slot", quantize="int4")
 
+profile_stop(_trace_dir)
 print("RESULT_JSON " + json.dumps({
     "job": "engine_sweep", "device": DEV, "n_params": n_params,
-    "peak_flops": peak, "hbm_gbs": hbm, "points": points}))
+    "peak_flops": peak, "hbm_gbs": hbm, "points": points,
+    "xprof_trace": _trace_dir}))

@@ -35,6 +35,10 @@ from gofr_tpu.models.llama import (LlamaConfig, llama_init, make_empty_cache,
 out = {"job": "decode_microprof", "backend": jax.default_backend(),
        "device": jax.devices()[0].device_kind}
 
+# GOFR_JOB_PROFILE=1: xprof capture of the whole measured region
+from _profiling import profile_start, profile_stop
+_trace_dir = profile_start("decode_microprof")
+
 c = LlamaConfig.tiny() if SMOKE else LlamaConfig.llama3_1b().scaled(
     max_seq=1024)
 B = 4 if SMOKE else 16
@@ -183,4 +187,6 @@ if not SMOKE:
     out["bare_step_seq256_ms"] = round(
         timed_donated(step_s, kc_s, vc_s) * 1e3, 2)
 
+profile_stop(_trace_dir)
+out["xprof_trace"] = _trace_dir
 print(json.dumps(out))

@@ -42,6 +42,10 @@ from gofr_tpu.ops.paged_kv import gather_view, scatter_decode
 out = {"job": "prefill_microprof", "backend": jax.default_backend(),
        "device": jax.devices()[0].device_kind}
 
+# GOFR_JOB_PROFILE=1: xprof capture of the whole measured region
+from _profiling import profile_start, profile_stop
+_trace_dir = profile_start("prefill_microprof")
+
 c = LlamaConfig.tiny() if SMOKE else LlamaConfig.llama3_1b().scaled(
     max_seq=1024)
 B = 2 if SMOKE else 8
@@ -144,4 +148,6 @@ out["native_vs_view_speedup"] = round(t_view / t_native, 3)
 out["config"] = (f"B={B} chunk={CHUNK} max_seq={MAX_SEQ} "
                  f"page={PAGE} impl={IMPL}")
 
+profile_stop(_trace_dir)
+out["xprof_trace"] = _trace_dir
 print(json.dumps(out))

@@ -123,6 +123,10 @@ def _reenqueue_all(sha: str) -> int:
         if not name.endswith(".py"):
             continue
         shutil.copy(os.path.join(JOBS, name), os.path.join(QUEUE, name))
+        if name.startswith("_"):
+            # _-prefixed files are shared helpers (e.g. _profiling.py):
+            # copied so queued job copies can import them, never run
+            continue
         _attempts.pop(name, None)
         n += 1
     if n:
@@ -215,7 +219,8 @@ def main() -> None:
         if sha != "?" and sha != last_sha:  # "?" = transient git hiccup
             last_sha = sha
             _reenqueue_all(sha)
-        jobs = sorted(f for f in os.listdir(QUEUE) if f.endswith(".py"))
+        jobs = sorted(f for f in os.listdir(QUEUE)
+                      if f.endswith(".py") and not f.startswith("_"))
         drained = False
         if jobs and _probe() is not None:
             # tunnel healthy right now — drain while it lasts, but

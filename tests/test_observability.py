@@ -1,0 +1,320 @@
+"""Serving-path observability: engine tracing, flight recorder, the
+full Prometheus engine surface, and the profiler-capture endpoints.
+
+The hard invariant under test: observability fully enabled (tracer +
+flight recorder + metrics) adds ZERO host->device transfers to the
+steady-state decode path and does not change a single generated token.
+Everything is assembled host-side from timestamps the engine already
+collects (serving/observability.py).
+"""
+
+import json
+import re
+import time
+from pathlib import Path
+
+import jax
+import pytest
+
+from gofr_tpu.container.container import Container
+from gofr_tpu.metrics.registry import Manager as MetricsManager
+from gofr_tpu.serving.engine import EngineConfig, SamplingParams
+from gofr_tpu.serving.glue import demo_llama_engine
+from gofr_tpu.serving.observability import FlightRecorder, ProfilerCapture
+from gofr_tpu.serving.tokenizer import ByteTokenizer
+from gofr_tpu.tracing.tracer import InMemoryExporter, Tracer
+
+from .apputil import AppRunner
+
+SERVING_DIR = Path(__file__).resolve().parent.parent / "gofr_tpu" / "serving"
+
+# first string-literal argument of any metrics write call
+_WRITE_RE = re.compile(
+    r"(?:record_histogram|set_gauge|increment_counter|add_counter|"
+    r"delta_up_down_counter)\(\s*['\"]([A-Za-z0-9_]+)['\"]")
+
+
+def _run(eng, prompts, n, *, tracer=None, timeout=120):
+    eng.start()
+    sp = SamplingParams(temperature=0.0, max_new_tokens=n)
+    if tracer is not None:
+        with tracer.start_span("parent"):
+            reqs = [eng.submit(p, sp) for p in prompts]
+    else:
+        reqs = [eng.submit(p, sp) for p in prompts]
+    deadline = time.time() + timeout
+    while time.time() < deadline and any(
+            r.finished_at is None and r.error is None for r in reqs):
+        time.sleep(0.005)
+    eng.stop()
+    assert all(r.error is None for r in reqs), [r.error for r in reqs]
+    return reqs
+
+
+# ------------------------------------------------------ registry coverage
+def test_every_serving_metric_write_is_registered():
+    """Every metric name written anywhere under gofr_tpu/serving/ must
+    be registered by attach_metrics or the container's framework set —
+    an unregistered write is a silent log-and-drop."""
+    written = set()
+    for path in SERVING_DIR.glob("*.py"):
+        written.update(_WRITE_RE.findall(path.read_text()))
+    assert written, "no metric writes found — the scan regex broke"
+
+    container = Container()
+    container.register_framework_metrics()
+    eng = demo_llama_engine(EngineConfig(max_batch=2, max_seq=64))
+    eng.attach_metrics(container.metrics)
+    missing = sorted(n for n in written
+                     if container.metrics.get(n) is None)
+    assert not missing, (
+        f"metric(s) written in serving/ but never registered: {missing}")
+
+
+def test_attach_metrics_registers_on_bare_manager():
+    """An engine attached to a fresh Manager (no container) registers
+    its full surface itself — serve_model-less embedding works."""
+    m = MetricsManager()
+    eng = demo_llama_engine(EngineConfig(max_batch=2, max_seq=64))
+    eng.attach_metrics(m)
+    for name in ("app_engine_batch_occupancy", "app_chat_queue_seconds",
+                 "app_chat_tpot_seconds", "app_chat_e2e_seconds",
+                 "app_engine_kv_pool_utilization", "app_engine_mfu",
+                 "app_engine_preemptions", "app_engine_spec_drafted"):
+        assert m.get(name) is not None, name
+
+
+# -------------------------------------------- zero-perturbation invariant
+def test_steady_state_zero_h2d_with_observability_enabled():
+    """The transfer-guard contract of test_decode_state, with tracing +
+    flight recorder + metrics ALL on: steady-state decode still uploads
+    nothing."""
+    container = Container()
+    container.register_framework_metrics()
+    tracer = Tracer(exporter=InMemoryExporter())
+    eng = demo_llama_engine(EngineConfig(max_batch=4, max_seq=256,
+                                         seed=0), tracer=tracer)
+    eng.attach_metrics(container.metrics)
+    params = SamplingParams(temperature=0.0, max_new_tokens=200)
+    with tracer.start_span("parent"):
+        reqs = [eng.submit([1 + i, 2, 3], params) for i in range(3)]
+    batch = eng.waiting.pop_batch(len(reqs), first_wait_s=0.5)
+    assert batch and len(batch) == len(reqs)
+    eng._admit_batch(batch)
+    eng._collect_prefills()
+    # two unguarded passes: admission upload, then the use_prev flip
+    for _ in range(2):
+        eng._decode_step()
+        eng._drain_pending()
+    transfers = eng.stats["h2d_transfers"]
+    with jax.transfer_guard_host_to_device("disallow"):
+        for _ in range(3):
+            eng._decode_step()
+            eng._drain_pending()
+    assert eng.stats["h2d_transfers"] == transfers
+    # ...and the observability layer actually observed those passes
+    kinds = [p["kind"] for p in eng.recorder.snapshot()["passes"]]
+    assert kinds.count("decode") >= 5
+    assert container.metrics.get_histogram_count(
+        "app_engine_batch_occupancy") >= 5
+    last = eng.recorder.snapshot()["passes"][-1]
+    assert last["h2d"] == 0 and last["occupancy"] == 3
+    assert last["tokens"] > 0
+
+
+@pytest.mark.parametrize("layout_kw", [
+    {},
+    {"kv_layout": "paged", "page_size": 16, "paged_attention": "view"},
+])
+def test_greedy_bit_identical_with_observability_enabled(layout_kw):
+    """Greedy token streams with tracer+recorder+metrics enabled are
+    bit-identical to the bare engine (both KV layouts)."""
+    prompts = [[5 + i, 2, 9] for i in range(3)]
+
+    def cfg():
+        return EngineConfig(max_batch=4, max_seq=128, seed=11,
+                            **layout_kw)
+
+    bare = demo_llama_engine(cfg())
+    want = [r.generated for r in _run(bare, prompts, 24)]
+
+    container = Container()
+    container.register_framework_metrics()
+    tracer = Tracer(exporter=InMemoryExporter())
+    obs = demo_llama_engine(cfg(), tracer=tracer)
+    obs.attach_metrics(container.metrics)
+    got_reqs = _run(obs, prompts, 24, tracer=tracer)
+    assert [r.generated for r in got_reqs] == want
+    # the observed run produced spans for every request
+    names = [s.name for s in tracer.exporter.spans]
+    assert names.count("engine.request") == len(prompts)
+
+
+# --------------------------------------------------------- flight recorder
+def test_flight_recorder_ring_and_request_logs():
+    rec = FlightRecorder(size=4, request_logs=2)
+    for i in range(10):
+        rec.record_pass("decode", tokens=i)
+    snap = rec.snapshot()
+    assert len(snap["passes"]) == 4                    # ring bounded
+    assert [p["tokens"] for p in snap["passes"]] == [6, 7, 8, 9]
+    assert snap["passes_recorded"] == 10
+    assert rec.snapshot(2)["passes"][-1]["seq"] == 10  # last-N works
+    assert rec.summary()["by_kind"] == {"decode": 10}
+    disabled = FlightRecorder(size=0)
+    disabled.record_pass("decode")
+    assert disabled.snapshot()["passes"] == []
+
+
+def test_engine_health_and_crash_dump_carry_flight_summary():
+    eng = demo_llama_engine(EngineConfig(max_batch=2, max_seq=64, seed=3))
+
+    class SpyLogger:
+        lines: list = []
+
+        def error(self, msg, **kw):
+            self.lines.append(str(msg))
+
+        def warn(self, msg, **kw):
+            pass
+
+        def info(self, msg, **kw):
+            pass
+
+    eng.logger = SpyLogger()
+    _run(eng, [[1, 2, 3]], 6)
+    health = eng.health_check()
+    assert health["flight"]["passes_recorded"] >= 1
+    eng._crash(RuntimeError("boom"))
+    assert any("flight recorder" in ln for ln in SpyLogger.lines)
+    assert eng.health_check()["status"] == "DOWN"
+
+
+def test_spec_verify_recorded_in_ring_and_counters():
+    m = MetricsManager()
+    eng = demo_llama_engine(EngineConfig(
+        max_batch=2, max_seq=256, seed=5, speculative=True,
+        spec_ngram=1, decode_steps_per_pass=2))
+    eng.attach_metrics(m)
+    pattern = [7, 11, 13, 7, 11, 13, 7, 11]
+    _run(eng, [pattern], 24)
+    assert eng.stats["spec_passes"] > 0
+    kinds = {p["kind"] for p in eng.recorder.snapshot()["passes"]}
+    assert "spec_verify" in kinds
+    assert m.get("app_engine_spec_drafted").get() > 0
+    assert m.get("app_engine_spec_accepted").get() >= 0
+
+
+# -------------------------------------------------------------- profiler
+def test_profiler_capture_single_flight(tmp_path):
+    cap = ProfilerCapture(base_dir=str(tmp_path))
+    out = cap.start()
+    assert out["ok"], out
+    again = cap.start()
+    assert not again["ok"] and "already" in again["error"]
+    assert cap.status()["running"]
+    stopped = cap.stop()
+    assert stopped["ok"] and stopped["dir"] == out["dir"]
+    assert not cap.status()["running"]
+    assert not cap.stop()["ok"]  # idempotent-safe
+
+
+# ------------------------------------------------------------------- e2e
+@pytest.fixture(scope="module")
+def obs_app():
+    engine = demo_llama_engine(EngineConfig(
+        max_batch=4, max_seq=128, seed=0, kv_layout="paged",
+        page_size=16, prefix_cache=True, paged_attention="view"))
+
+    def build(app):
+        app.serve_model("llm", engine, ByteTokenizer())
+
+    runner = AppRunner(build=build,
+                       config={"TRACE_EXPORTER": "memory",
+                               "PROFILER_ENABLED": "true"})
+    with runner as app:
+        yield app
+
+
+def test_e2e_traceparent_links_engine_spans(obs_app):
+    """A chat request with a W3C traceparent produces linked engine.*
+    child spans in the in-memory exporter: HTTP span -> engine.request
+    -> queue/prefill/decode/retire, one trace end to end."""
+    trace_id = "ab" * 16
+    status, _, data = obs_app.request(
+        "POST", "/chat",
+        {"prompt": "trace me end to end", "max_tokens": 8,
+         "temperature": 0.0},
+        headers={"traceparent": f"00-{trace_id}-{'cd' * 8}-01"})
+    assert status == 201
+    body = json.loads(data)["data"]
+    assert body["usage"]["tpot_ms"] is not None
+    spans = obs_app.app.container.tracer.exporter.spans
+    mine = [s for s in spans if s.trace_id == trace_id]
+    http_span = next(s for s in mine if s.name == "POST /chat")
+    assert http_span.parent_id == "cd" * 8
+    by_name = {s.name: s for s in mine}
+    root = by_name["engine.request"]
+    assert root.parent_id == http_span.span_id
+    for name in ("engine.queue", "engine.prefill", "engine.decode",
+                 "engine.retire"):
+        assert by_name[name].parent_id == root.span_id, name
+    assert by_name["engine.decode"].attributes["tokens"] == 8
+    assert by_name["engine.queue"].end_time >= by_name[
+        "engine.queue"].start_time
+
+
+def test_e2e_debug_engine_returns_pass_records(obs_app):
+    status, body = obs_app.get_json("/debug/engine?n=8")
+    assert status == 200
+    llm = body["data"]["llm"]
+    assert llm["health"]["status"] == "UP"
+    assert llm["flight"]["passes"], "no pass records served"
+    assert len(llm["flight"]["passes"]) <= 8
+    last = llm["flight"]["passes"][-1]
+    assert {"seq", "kind", "t"} <= set(last)
+
+
+def test_e2e_metrics_expose_engine_surface(obs_app):
+    # a second request makes sure samples exist regardless of ordering,
+    # then give the throttled gauges one refresh window
+    status, _, _ = obs_app.request(
+        "POST", "/chat", {"prompt": "trace me end to end",
+                          "max_tokens": 8, "temperature": 0.0})
+    assert status == 201
+    time.sleep(0.6)
+    _, _, data = obs_app.request("GET", "/metrics",
+                                 port=obs_app.metrics_port)
+    text = data.decode()
+    series = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        name_part, _, value = line.rpartition(" ")
+        series[name_part.split("{", 1)[0]] = float(value)
+    for name in ("app_chat_queue_seconds_count",
+                 "app_chat_tpot_seconds_count",
+                 "app_chat_e2e_seconds_count",
+                 "app_engine_batch_occupancy_count",
+                 "app_engine_kv_pool_utilization"):
+        assert series.get(name, 0.0) > 0.0, (name, series.get(name))
+    # present even when zero-valued on CPU
+    for name in ("app_engine_mfu", "app_engine_tokens_per_second",
+                 "app_engine_kv_pool_fragmentation",
+                 "app_engine_prefix_cache_pages"):
+        assert name in series, name
+
+
+def test_e2e_profiler_endpoints(obs_app, tmp_path_factory):
+    target = str(tmp_path_factory.mktemp("xprof"))
+    status, _, data = obs_app.request("POST", "/debug/profile/start",
+                                      {"dir": target})
+    assert status in (200, 201)
+    out = json.loads(data)["data"]
+    assert out["ok"], out
+    # double-start is refused, not crashed
+    status, _, data = obs_app.request("POST", "/debug/profile/start", {})
+    assert not json.loads(data)["data"]["ok"]
+    status, _, data = obs_app.request("POST", "/debug/profile/stop", {})
+    stopped = json.loads(data)["data"]
+    assert stopped["ok"] and stopped["dir"] == target
