@@ -537,6 +537,13 @@ def _cached_tpu_result():
     if best is None:
         return None
     payload, ts, name = best
+    # provenance hygiene: a cached payload may carry the stderr tail /
+    # diagnostics of the RUN THAT PRODUCED IT — r5's cached result
+    # spliced a long-fixed Mosaic compile error into a healthy round.
+    # Stale run noise never rides into today's report.
+    for stale in ("tail", "stderr", "fallback_reason", "fresh_cpu",
+                  "status", "error"):
+        payload.pop(stale, None)
     payload["cached"] = True
     payload["measured_at"] = ts
     payload["cached_age_s"] = round(_time.time() - ts, 1)
@@ -568,6 +575,7 @@ def main() -> None:
                 # TPU run earlier in the round — report that, PLUS a
                 # fresh CPU run of the code actually under test (the
                 # cached number may predate it within the age window)
+                cached["status"] = "cached"
                 cached["fallback_reason"] = "; ".join(errors)
                 fresh, fresh_err = _bench("cpu", CPU_BENCH_TIMEOUT_S)
                 cached["fresh_cpu"] = (fresh if fresh is not None
@@ -581,15 +589,23 @@ def main() -> None:
         if payload is not None:
             if platform == "cpu" and errors:
                 # valid run, but degraded: label why the TPU path was skipped
+                payload["status"] = "fallback"
                 payload["fallback_reason"] = "; ".join(errors)
+            else:
+                payload["status"] = "fresh"
             break
         errors.append(_trunc(f"{platform}: {error}"))
         print(f"# bench[{platform}] failed: {error}", file=sys.stderr)
 
     if payload is None:
+        # no measurement at all: say so AND exit nonzero — an rc-0 run
+        # whose payload cannot be parsed reads as a healthy bench in
+        # the round artifacts (BENCH_r05.json: rc 0, parsed null)
         payload = {"metric": "chat_req_per_s", "value": 0.0, "unit": "req/s",
-                   "vs_baseline": 0.0,
+                   "vs_baseline": 0.0, "status": "error",
                    "error": _trunc("; ".join(errors) or "unknown")}
+        print(json.dumps(payload))
+        sys.exit(1)
 
     print(json.dumps(payload))
 

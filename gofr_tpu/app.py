@@ -281,6 +281,49 @@ class App:
         # join budget, not stop()'s full 30s
         self.on_shutdown(engine.close)
 
+    # ------------------------------------------------------------- fleet
+    def serve_fleet_leader(self, *, coordinator: str = "",
+                           host_id: str = "leader", **kw):
+        """Install a multi-host control-plane LEADER on this app:
+        join/heartbeat/topology routes, the federated
+        ``/control/fleet/metrics`` Prometheus surface and the
+        consolidated ``/debug/fleet`` JSON view, wired to the
+        container's logger and metrics manager. Returns the
+        :class:`~gofr_tpu.serving.control_plane.ControlPlaneLeader`."""
+        from .serving.control_plane import ControlPlaneLeader
+        leader = ControlPlaneLeader(coordinator=coordinator,
+                                    host_id=host_id,
+                                    logger=self.logger, **kw)
+        leader.install(self)
+        return leader
+
+    def join_fleet(self, leader_url: str, *, host_id: str,
+                   engine=None, address: str = "", **kw):
+        """Join this app to a serving-group leader as a WORKER: the
+        agent heartbeats with the engine's health, flight-recorder
+        digest and this container's metrics snapshot attached, carries
+        ``traceparent`` on every control RPC, and sets the fleet
+        context (host_id/rank/generation) that enriches every log
+        record and span. ``engine=None`` picks the first served model.
+        Starts with the app, stops with it."""
+        from .serving.control_plane import (WorkerAgent,
+                                            engine_fleet_sources)
+        if engine is None and self.container.models:
+            engine = next(iter(self.container.models.values()))
+        sources: dict = {}
+        if engine is not None:
+            health, summary, _metrics = engine_fleet_sources(engine)
+            sources = {"health_source": health,
+                       "summary_source": summary}
+        kw.setdefault("metrics_source", self.container.metrics.snapshot)
+        agent = WorkerAgent(leader_url, host_id=host_id,
+                            address=address,
+                            tracer=self.container.tracer,
+                            logger=self.logger, **{**sources, **kw})
+        self.on_start(lambda c: agent.start())
+        self.on_shutdown(agent.stop)
+        return agent
+
     def _install_debug_routes(self) -> None:
         """Serving debug surface, registered once with the first
         ``serve_model``: ``GET /debug/engine`` (flight-recorder pass

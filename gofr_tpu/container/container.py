@@ -224,6 +224,26 @@ class Container:
                         "per-request mean inter-token latency",
                         buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01,
                                  0.025, 0.05, 0.1, 0.25, 0.5, 1))
+        # fleet (multi-host control plane) series, written by
+        # serving/control_plane.py on the leader; zero-valued on hosts
+        # that never lead a serving group
+        m.new_gauge("app_fleet_world_size",
+                    "control-plane serving-group members")
+        m.new_gauge("app_fleet_generation",
+                    "control-plane membership generation")
+        m.new_gauge("app_fleet_pass_skew",
+                    "max/median p95 pass duration across hosts "
+                    "(1 = balanced)")
+        m.new_gauge("app_fleet_occupancy_skew",
+                    "max/median mean batch occupancy across hosts")
+        m.new_gauge("app_fleet_straggler_ratio",
+                    "fraction of hosts whose p95 pass duration exceeds "
+                    "straggler_ratio x the fleet median")
+        m.new_counter("app_fleet_evictions",
+                      "hosts evicted from the serving group "
+                      "(by reason label)")
+        m.new_counter("app_fleet_heartbeats",
+                      "control-plane heartbeats received")
 
     # ------------------------------------------------------------- health
     def health(self) -> dict[str, Any]:

@@ -58,6 +58,31 @@ def current_trace_ids() -> tuple[str, str] | None:
     return _trace_ctx.get()
 
 
+# ------------------------------------------------- fleet (host) context
+#
+# Process-wide host identity for multi-host serving: set once at
+# control-plane join (serving/control_plane.py) and merged into every
+# log record next to trace_id/span_id, and into every span's
+# attributes by the tracer — so one grep (and one trace) correlates
+# leader and worker. A plain dict, not a contextvar: the whole process
+# IS one host, there is nothing request-scoped about it.
+_fleet_ctx: dict[str, Any] = {}
+
+
+def set_fleet_context(**attrs: Any) -> None:
+    """Merge host identity (``host_id``, ``rank``, ``generation``) into
+    the process-wide fleet context; None values are dropped."""
+    _fleet_ctx.update({k: v for k, v in attrs.items() if v is not None})
+
+
+def clear_fleet_context() -> None:
+    _fleet_ctx.clear()
+
+
+def current_fleet_context() -> dict[str, Any]:
+    return dict(_fleet_ctx)
+
+
 @runtime_checkable
 class PrettyPrint(Protocol):
     """Structured records that know how to render a colored one-liner.
@@ -128,6 +153,8 @@ class Logger:
         }
         if trace:
             record["trace_id"], record["span_id"] = trace
+        for k, v in _fleet_ctx.items():
+            record.setdefault(k, v)
         if isinstance(message, PrettyPrint):
             record["message"] = getattr(message, "__dict__", str(message))
         elif isinstance(message, (dict, list, str, int, float, bool, type(None))):
@@ -153,6 +180,9 @@ class Logger:
                 message.pretty_print(out)
             else:
                 out.write(str(message))
+            if _fleet_ctx:
+                out.write(" " + " ".join(f"{k}={v}"
+                                         for k, v in _fleet_ctx.items()))
             if fields:
                 out.write(" " + " ".join(f"{k}={v}" for k, v in fields.items()))
             out.write("\n")

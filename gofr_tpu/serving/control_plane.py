@@ -20,27 +20,63 @@ Protocol (all JSON over the framework's HTTP):
 - ``POST /control/join`` {host_id, address, n_devices, health?}
   -> {generation, assignment} and bumps the generation: membership
   changed, every host must re-coordinate.
-- ``POST /control/heartbeat`` {host_id, generation, health?}
-  -> {ok, generation, assignment} — a worker heartbeating with a stale
-  generation learns its new assignment right there (elastic restart:
-  ranks are contiguous again after an eviction or a join).
+- ``POST /control/heartbeat`` {host_id, generation, health?, summary?,
+  metrics?} -> {ok, generation, assignment} — a worker heartbeating
+  with a stale generation learns its new assignment right there
+  (elastic restart: ranks are contiguous again after an eviction or a
+  join). ``summary`` is the worker's flight-recorder digest
+  (p50/p95 pass duration, occupancy, queue depth, tokens/s) and
+  ``metrics`` its ``Manager.snapshot()`` — the fleet observability
+  plane rides the heartbeats the protocol already pays for.
 - ``GET /control/topology`` -> members, assignments, gossiped health —
   also surfaced through the leader app's health endpoint.
+- ``GET /control/fleet/metrics`` -> the FEDERATED Prometheus surface:
+  every member's snapshot with ``host``/``rank`` labels plus the
+  leader's computed ``app_fleet_*`` series, one scrape for the group.
+- ``GET /debug/fleet`` -> consolidated JSON: per-host flight
+  summaries, pass/occupancy skew, stragglers, counter totals.
 
 Failure detection: the leader sweeps heartbeat deadlines; a host that
 misses ``eviction_misses`` intervals is evicted and the generation
-bumps. Workers detect leader loss through the service client's circuit
+bumps. A heartbeat gossiping DEGRADED health (e.g. the engine stall
+watchdog fired) is evicted IMMEDIATELY when
+``FleetConfig.evict_degraded`` — survivors re-rank through the normal
+elastic-regeneration path instead of waiting out heartbeat silence.
+Workers detect leader loss through the service client's circuit
 breaker and keep retrying with backoff.
+
+Straggler detection: the leader derives max/median skew of p95 pass
+duration and mean occupancy across members from the heartbeat
+summaries, exposes them as ``app_fleet_pass_skew`` /
+``app_fleet_occupancy_skew`` / ``app_fleet_straggler_ratio`` gauges,
+and WARN-logs the offending host when skew crosses
+``FleetConfig.straggler_ratio``.
+
+Cross-host trace stitching: join/heartbeat RPCs carry ``traceparent``
+(the worker wraps each RPC in a ``control.*`` span; the service client
+injects the header; the leader's tracing middleware continues the
+trace), and both sides set the process-wide fleet context
+(host_id/rank/generation) that the tracer and logger merge into every
+span and log record — one trace and one grep correlate leader and
+worker.
+
+Everything here is host-side assembly of data the engine already
+records (PR 3's zero-hot-path-perturbation invariant): snapshots and
+summaries are read on heartbeat threads, skew is leader-side
+arithmetic, and the stall watchdog polls ``health_check()``.
 """
 
 from __future__ import annotations
 
+import statistics
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from ..http.errors import ErrorInvalidParam, HTTPError
+from ..logging.logger import set_fleet_context
+from ..metrics.registry import merge_snapshots, render_federated
 
 
 class StaleGeneration(HTTPError):
@@ -48,6 +84,58 @@ class StaleGeneration(HTTPError):
     to rejoin (which returns the fresh assignment)."""
 
     status_code = 409
+
+
+@dataclass
+class FleetConfig:
+    """Knobs for the fleet observability plane (docs/configs.md)."""
+
+    #: workers attach ``Manager.snapshot()`` to heartbeats and the
+    #: leader serves the federated surface; off = heartbeats carry
+    #: only health + flight summary (cheaper wire, no /fleet/metrics
+    #: series for this worker)
+    federation: bool = True
+    #: a host whose p95 pass duration exceeds this multiple of the
+    #: fleet median is flagged a straggler (gauge + WARN)
+    straggler_ratio: float = 2.0
+    #: evict a member the moment its heartbeat gossips DEGRADED
+    #: health (stall watchdog escalation) instead of waiting for
+    #: heartbeat silence
+    evict_degraded: bool = True
+
+
+def engine_fleet_sources(engine: Any) -> tuple[Callable[[], dict],
+                                               Callable[[], dict],
+                                               Callable[[], dict | None]]:
+    """(health, summary, metrics) heartbeat sources for a WorkerAgent
+    wrapping a serving engine: gossip-sized health, the flight
+    recorder's fleet digest, and the attached metrics manager's
+    snapshot. All host-side reads — safe at heartbeat cadence."""
+
+    def health() -> dict:
+        h = engine.health_check()
+        out = {"status": h.get("status", "UP")}
+        for key in ("error", "stalled_for_s", "stalls"):
+            if key in h:
+                out[key] = h[key]
+        return out
+
+    def summary() -> dict:
+        recorder = getattr(engine, "recorder", None)
+        out = recorder.fleet_summary() if recorder is not None \
+            and recorder.enabled else {}
+        out["active_slots"] = sum(r is not None for r in engine.active)
+        out["waiting"] = engine.waiting.qsize()
+        out["total_generated"] = engine.total_generated
+        return out
+
+    def metrics() -> dict | None:
+        manager = getattr(engine, "metrics", None)
+        if manager is None or not hasattr(manager, "snapshot"):
+            return None
+        return manager.snapshot()
+
+    return health, summary, metrics
 
 
 @dataclass
@@ -83,6 +171,30 @@ class _Member:
     n_devices: int
     last_seen: float
     health: dict = field(default_factory=dict)
+    #: flight-recorder digest from the last heartbeat (straggler math)
+    summary: dict = field(default_factory=dict)
+    #: last attached Manager.snapshot() (metrics federation)
+    metrics_snapshot: dict | None = None
+
+
+#: gauge/counter families the leader writes; registered by the
+#: container's framework set and (belt-and-braces) on install()
+_FLEET_GAUGES = (
+    ("app_fleet_world_size", "control-plane serving-group members"),
+    ("app_fleet_generation", "control-plane membership generation"),
+    ("app_fleet_pass_skew",
+     "max/median p95 pass duration across hosts (1 = balanced)"),
+    ("app_fleet_occupancy_skew",
+     "max/median mean batch occupancy across hosts"),
+    ("app_fleet_straggler_ratio",
+     "fraction of hosts whose p95 pass duration exceeds "
+     "straggler_ratio x the fleet median"),
+)
+_FLEET_COUNTERS = (
+    ("app_fleet_evictions",
+     "hosts evicted from the serving group (by reason label)"),
+    ("app_fleet_heartbeats", "control-plane heartbeats received"),
+)
 
 
 class ControlPlaneLeader:
@@ -95,16 +207,43 @@ class ControlPlaneLeader:
     def __init__(self, *, coordinator: str = "",
                  heartbeat_interval_s: float = 2.0,
                  eviction_misses: int = 3,
+                 fleet: FleetConfig | None = None,
+                 host_id: str = "",
+                 metrics: Any = None,
                  logger: Any = None) -> None:
         self.coordinator = coordinator
         self.heartbeat_interval_s = heartbeat_interval_s
         self.eviction_misses = eviction_misses
+        self.fleet = fleet if fleet is not None else FleetConfig()
+        self.host_id = host_id
+        self.metrics = metrics
         self.logger = logger
         self.generation = 0
         self._members: dict[str, _Member] = {}
+        self._stragglers: set[str] = set()
         self._lock = threading.Lock()
         self._sweeper: threading.Thread | None = None
         self._running = False
+        if metrics is not None:
+            self._register_metrics(metrics)
+
+    # ---------------------------------------------------- fleet metrics
+    @staticmethod
+    def _register_metrics(metrics: Any) -> None:
+        for name, desc in _FLEET_GAUGES:
+            if metrics.get(name) is None:
+                metrics.new_gauge(name, desc)
+        for name, desc in _FLEET_COUNTERS:
+            if metrics.get(name) is None:
+                metrics.new_counter(name, desc)
+
+    def _set_membership_gauges(self) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.set_gauge("app_fleet_world_size",
+                               float(len(self._members)))
+        self.metrics.set_gauge("app_fleet_generation",
+                               float(self.generation))
 
     # ------------------------------------------------------------ state
     def _ranks_locked(self) -> dict[str, int]:
@@ -132,6 +271,7 @@ class ControlPlaneLeader:
                 n_devices=max(1, int(n_devices)),
                 last_seen=time.time(), health=dict(health or {}))
             assignment = self._assignment_locked(host_id)
+        self._set_membership_gauges()
         if self.logger:
             self.logger.info(
                 "host joined serving group", host=host_id,
@@ -140,10 +280,17 @@ class ControlPlaneLeader:
         return assignment
 
     def heartbeat(self, host_id: str, generation: int,
-                  health: dict | None = None
-                  ) -> tuple[ShardAssignment, bool]:
+                  health: dict | None = None,
+                  summary: dict | None = None,
+                  metrics_snapshot: dict | None = None
+                  ) -> tuple[ShardAssignment | None, bool]:
         """-> (assignment, changed): ``changed`` is True when the
-        worker's view was stale — its signal to re-coordinate."""
+        worker's view was stale — its signal to re-coordinate.
+        ``assignment`` is None when the heartbeat itself got the host
+        evicted (DEGRADED health under ``FleetConfig.evict_degraded``)
+        — the route answers with an eviction notice, not a 409, so
+        the agent backs off instead of instantly rejoining wedged."""
+        degraded = False
         with self._lock:
             member = self._members.get(host_id)
             if member is None:
@@ -151,17 +298,43 @@ class ControlPlaneLeader:
             member.last_seen = time.time()
             if health is not None:
                 member.health = dict(health)
-            return (self._assignment_locked(host_id),
-                    generation != self.generation)
+            if summary is not None:
+                member.summary = dict(summary)
+            if metrics_snapshot is not None:
+                member.metrics_snapshot = metrics_snapshot
+            # DEGRADED (the stall-watchdog escalation) evicts NOW so
+            # survivors re-rank; DOWN keeps gossiping — a dead engine
+            # whose agent still heartbeats stays visible to operators
+            # in topology/health rather than silently vanishing
+            status = member.health.get("status", "UP")
+            if status == "DEGRADED" and self.fleet.evict_degraded:
+                degraded = True
+            else:
+                assignment = self._assignment_locked(host_id)
+                changed = generation != self.generation
+        if self.metrics is not None:
+            self.metrics.increment_counter("app_fleet_heartbeats",
+                                           host=host_id)
+        if degraded:
+            self.evict(host_id, reason="degraded")
+            return None, True
+        self._recompute_skew()
+        return assignment, changed
 
-    def evict(self, host_id: str) -> None:
+    def evict(self, host_id: str, reason: str = "manual") -> None:
         with self._lock:
             if self._members.pop(host_id, None) is None:
                 return
             self.generation += 1
+            self._stragglers.discard(host_id)
+        self._set_membership_gauges()
+        if self.metrics is not None:
+            self.metrics.increment_counter("app_fleet_evictions",
+                                           reason=reason)
         if self.logger:
             self.logger.warn("host evicted from serving group",
-                             host=host_id, generation=self.generation)
+                             host=host_id, reason=reason,
+                             generation=self.generation)
 
     def topology(self) -> dict[str, Any]:
         with self._lock:
@@ -188,6 +361,120 @@ class ControlPlaneLeader:
                             "world_size": topo["world_size"],
                             "degraded_hosts": degraded}}
 
+    # ------------------------------------------------------- stragglers
+    @staticmethod
+    def _skew(values: dict[str, float]) -> tuple[float, str | None]:
+        """max/median of per-host values -> (skew, worst host). 1.0
+        when balanced or under 2 hosts report."""
+        if len(values) < 2:
+            return 1.0, None
+        med = statistics.median(values.values())
+        if med <= 0:
+            return 1.0, None
+        worst = max(values, key=values.get)
+        return values[worst] / med, worst
+
+    def _recompute_skew(self) -> dict:
+        """Leader-side straggler math over the latest heartbeat
+        summaries: pure host arithmetic, called at heartbeat cadence.
+        Returns the fleet digest served by ``/debug/fleet``."""
+        with self._lock:
+            p95s = {h: float(m.summary["pass_p95_s"])
+                    for h, m in self._members.items()
+                    if isinstance(m.summary.get("pass_p95_s"),
+                                  (int, float))}
+            occs = {h: float(m.summary["occupancy_mean"])
+                    for h, m in self._members.items()
+                    if isinstance(m.summary.get("occupancy_mean"),
+                                  (int, float))}
+            world = len(self._members)
+        pass_skew, worst = self._skew(p95s)
+        occ_skew, _ = self._skew(occs)
+        threshold = self.fleet.straggler_ratio
+        med = statistics.median(p95s.values()) if len(p95s) >= 2 else 0.0
+        stragglers = sorted(h for h, v in p95s.items()
+                            if med > 0 and v > threshold * med)
+        new = set(stragglers) - self._stragglers
+        self._stragglers = set(stragglers)
+        ratio = len(stragglers) / world if world else 0.0
+        if self.metrics is not None:
+            self.metrics.set_gauge("app_fleet_pass_skew",
+                                   round(pass_skew, 4))
+            self.metrics.set_gauge("app_fleet_occupancy_skew",
+                                   round(occ_skew, 4))
+            self.metrics.set_gauge("app_fleet_straggler_ratio",
+                                   round(ratio, 4))
+        if new and self.logger:
+            for host in sorted(new):
+                self.logger.warn(
+                    "straggler detected: pass duration skewed off the "
+                    "fleet median", host=host,
+                    p95_s=p95s.get(host), median_s=round(med, 6),
+                    skew=round(pass_skew, 3), threshold=threshold)
+        return {"pass_skew": round(pass_skew, 4),
+                "occupancy_skew": round(occ_skew, 4),
+                "straggler_ratio": round(ratio, 4),
+                "stragglers": stragglers,
+                "worst_host": worst,
+                "threshold": threshold}
+
+    # ------------------------------------------------------ fleet views
+    def fleet_status(self) -> dict:
+        """The consolidated ``/debug/fleet`` JSON: per-host flight
+        summaries + gossiped health, skew/straggler digest, counter
+        totals merged across hosts."""
+        with self._lock:
+            ranks = self._ranks_locked()
+            now = time.time()
+            hosts = {
+                h: {"rank": ranks[h], "address": m.address,
+                    "status": m.health.get("status", "UP"),
+                    "health": dict(m.health),
+                    "last_seen_age_s": round(now - m.last_seen, 3),
+                    "summary": dict(m.summary),
+                    "federated": m.metrics_snapshot is not None}
+                for h, m in self._members.items()}
+            snaps = {h: m.metrics_snapshot
+                     for h, m in self._members.items()
+                     if m.metrics_snapshot is not None}
+            generation, world = self.generation, len(self._members)
+        totals: dict[str, float] = {}
+        merged = merge_snapshots(snaps)
+        for name, fam in merged["metrics"].items():
+            if fam.get("kind") != "counter":
+                continue
+            totals[name] = round(sum(float(s.get("value", 0.0))
+                                     for s in fam["series"]), 6)
+        return {"generation": generation, "world_size": world,
+                "fleet": self._recompute_skew(), "hosts": hosts,
+                "counter_totals": totals}
+
+    def fleet_metrics_text(self) -> str:
+        """The federated Prometheus exposition for
+        ``GET /control/fleet/metrics``: every member's snapshot with
+        ``host``/``rank`` labels (``app_fleet_*`` families excluded —
+        those are leader-computed and appended once from the leader's
+        own manager, so a leader that also joins as a worker never
+        emits a duplicate family)."""
+        self._recompute_skew()  # gauges fresh at scrape time
+        with self._lock:
+            ranks = self._ranks_locked()
+            per_host = {}
+            labels = {}
+            for h, m in self._members.items():
+                if m.metrics_snapshot is None:
+                    continue
+                metrics = {name: fam for name, fam in
+                           (m.metrics_snapshot.get("metrics")
+                            or {}).items()
+                           if not name.startswith("app_fleet_")}
+                per_host[h] = {"metrics": metrics}
+                labels[h] = {"host": h, "rank": str(ranks[h])}
+        text = render_federated(per_host, labels)
+        if self.metrics is not None:
+            text += self.metrics.render_prometheus(prefix="app_fleet_")
+        return text or "\n"
+
     # ---------------------------------------------------------- sweeper
     def _sweep_once(self) -> None:
         deadline = time.time() - (self.heartbeat_interval_s
@@ -196,7 +483,7 @@ class ControlPlaneLeader:
             dead = [h for h, m in self._members.items()
                     if m.last_seen < deadline]
         for host_id in dead:
-            self.evict(host_id)
+            self.evict(host_id, reason="heartbeat_timeout")
 
     def start(self) -> None:
         self._running = True
@@ -216,7 +503,17 @@ class ControlPlaneLeader:
     # ------------------------------------------------------------ routes
     def install(self, app: Any) -> None:
         """Register the control routes and start the sweeper when the
-        app starts (reference startup-hook pattern, gofr.go:359)."""
+        app starts (reference startup-hook pattern, gofr.go:359).
+        Adopts the app container's metrics manager (registering the
+        ``app_fleet_*`` families if absent) so the fleet gauges ride
+        the leader's own /metrics port too."""
+        if self.metrics is None:
+            self.metrics = app.container.metrics
+            self._register_metrics(self.metrics)
+        if self.host_id:
+            # leader-side half of cross-host correlation: every leader
+            # log/span names the host it ran on
+            set_fleet_context(host_id=self.host_id)
 
         @app.post("/control/join")
         def join(ctx):
@@ -237,7 +534,12 @@ class ControlPlaneLeader:
             assignment, changed = self.heartbeat(
                 str(body.get("host_id", "")),
                 int(body.get("generation", -1)),
-                body.get("health"))
+                body.get("health"),
+                body.get("summary"),
+                body.get("metrics") if self.fleet.federation else None)
+            if assignment is None:  # evicted on this very heartbeat
+                return {"ok": False, "evicted": True,
+                        "generation": self.generation}
             return {"ok": True, "changed": changed,
                     "generation": assignment.generation,
                     "assignment": assignment.to_dict()}
@@ -245,6 +547,17 @@ class ControlPlaneLeader:
         @app.get("/control/topology")
         def topology(ctx):
             return self.topology()
+
+        @app.get("/control/fleet/metrics")
+        def fleet_metrics(ctx):
+            from ..http.responder import ResponseData
+            return ResponseData(
+                status=200, body=self.fleet_metrics_text().encode(),
+                content_type="text/plain; version=0.0.4; charset=utf-8")
+
+        @app.get("/debug/fleet")
+        def debug_fleet(ctx):
+            return self.fleet_status()
 
         app.container.register_health_check("control_plane", self)
 
@@ -267,6 +580,10 @@ class WorkerAgent:
                  on_assignment: Callable[[ShardAssignment], None]
                  | None = None,
                  health_source: Callable[[], dict] | None = None,
+                 summary_source: Callable[[], dict] | None = None,
+                 metrics_source: Callable[[], dict | None] | None = None,
+                 fleet: FleetConfig | None = None,
+                 tracer: Any = None,
                  logger: Any = None, service: Any = None) -> None:
         from ..service import CircuitBreaker, Retry, new_http_service
         self.host_id = host_id
@@ -275,11 +592,18 @@ class WorkerAgent:
         self.heartbeat_interval_s = heartbeat_interval_s
         self.on_assignment = on_assignment
         self.health_source = health_source or (lambda: {"status": "UP"})
+        #: flight-recorder digest attached to every heartbeat (None =
+        #: no summary); wire with engine_fleet_sources(engine)
+        self.summary_source = summary_source
+        #: Manager.snapshot() attached when FleetConfig.federation
+        self.metrics_source = metrics_source
+        self.fleet = fleet if fleet is not None else FleetConfig()
+        self.tracer = tracer
         self.logger = logger
         self._service = service if service is not None else \
             new_http_service(leader_url, Retry(max_retries=2),
                              CircuitBreaker(threshold=5, interval_s=2.0),
-                             logger=logger)
+                             logger=logger, tracer=tracer)
         self.assignment: ShardAssignment | None = None
         self._running = False
         self._thread: threading.Thread | None = None
@@ -289,8 +613,41 @@ class WorkerAgent:
         import asyncio
         # the heartbeat thread is sync; the service client (circuit
         # breaker, retry, tracing) is async — one loop per call is
-        # cheap at heartbeat cadence
-        response = asyncio.run(self._service.post(path, json=body))
+        # cheap at heartbeat cadence. The control.* span makes the RPC
+        # the root of a cross-host trace: the service client injects
+        # its traceparent, the leader's middleware continues it.
+        span = None
+        if self.tracer is not None:
+            span = self.tracer.start_span(
+                "control." + path.rstrip("/").rsplit("/", 1)[-1],
+                attributes={"host_id": self.host_id})
+        try:
+            try:
+                asyncio.get_running_loop()
+            except RuntimeError:
+                response = asyncio.run(
+                    self._service.post(path, json=body))
+            else:
+                # called from inside a running loop (an app on_start
+                # hook): hop to a throwaway thread, carrying the
+                # context so the span still rides as traceparent
+                import concurrent.futures
+                import contextvars
+                ctx = contextvars.copy_context()
+                pool = concurrent.futures.ThreadPoolExecutor(1)
+                try:
+                    response = pool.submit(
+                        ctx.run, asyncio.run,
+                        self._service.post(path, json=body)).result(30)
+                finally:
+                    pool.shutdown(wait=False)
+        except Exception:
+            if span is not None:
+                span.set_status("ERROR: rpc failed")
+            raise
+        finally:
+            if span is not None:
+                span.end()
         if response.status == 409:
             return {"rejoin": True}
         if response.status >= 400:
@@ -311,9 +668,19 @@ class WorkerAgent:
             coordinator=raw.get("coordinator", ""))
         old = self.assignment
         self.assignment = new
+        # the fleet context every span attribute set and log record
+        # inherits from here on — set at join and on every re-rank
+        set_fleet_context(host_id=self.host_id, rank=new.rank,
+                          generation=new.generation)
         if (old is None or old.generation != new.generation) \
                 and self.on_assignment is not None:
             self.on_assignment(new)
+
+    def _healthy(self) -> bool:
+        try:
+            return self.health_source().get("status", "UP") == "UP"
+        except Exception:
+            return True  # a broken probe must not strand the agent
 
     def join(self) -> ShardAssignment:
         payload = self._post("/control/join", {
@@ -338,15 +705,39 @@ class WorkerAgent:
     def _heartbeat_once(self) -> None:
         generation = (self.assignment.generation
                       if self.assignment is not None else -1)
+        body: dict[str, Any] = {
+            "host_id": self.host_id, "generation": generation,
+            "health": self.health_source()}
+        if self.summary_source is not None:
+            try:
+                body["summary"] = self.summary_source()
+            except Exception:
+                pass  # a broken digest must not kill the heartbeat
+        if self.fleet.federation and self.metrics_source is not None:
+            try:
+                snap = self.metrics_source()
+            except Exception:
+                snap = None
+            if snap is not None:
+                body["metrics"] = snap
         try:
-            payload = self._post("/control/heartbeat", {
-                "host_id": self.host_id, "generation": generation,
-                "health": self.health_source()})
+            payload = self._post("/control/heartbeat", body)
         except Exception as exc:
             # leader unreachable: the circuit breaker is already
             # backing off — keep the last assignment and keep serving
             if self.logger:
                 self.logger.warn(f"control-plane heartbeat failed: {exc}")
+            return
+        if payload.get("evicted"):
+            # the leader acted on our DEGRADED gossip: drop the
+            # assignment and do NOT auto-rejoin until health clears
+            # (the run loop gates the rejoin on health_source) — a
+            # wedged host thrashing join/evict helps nobody
+            self.assignment = None
+            if self.logger:
+                self.logger.warn(
+                    "evicted by leader on degraded health; will "
+                    "rejoin when healthy", host=self.host_id)
             return
         if payload.get("rejoin"):
             try:
@@ -376,6 +767,8 @@ class WorkerAgent:
                 if not self._running:
                     return
                 if self.assignment is None:
+                    if not self._healthy():
+                        continue  # evicted-degraded: heal first
                     try:
                         self.join()
                     except Exception as exc:

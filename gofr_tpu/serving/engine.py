@@ -183,6 +183,14 @@ class EngineConfig:
     #: tunnel) flips health to DEGRADED so orchestrators can act —
     #: exceptions are contained separately (health DOWN). 0 disables.
     stall_threshold_s: float = 120.0
+    #: stall ESCALATION cadence: a watchdog thread polls
+    #: ``health_check()`` every this many seconds and, when the stall
+    #: flag flips, dumps the flight recorder, emits an ``engine.stall``
+    #: span + ``app_engine_stalls`` counter, and leaves health DEGRADED
+    #: for the next control-plane heartbeat so the leader can evict
+    #: instead of waiting for heartbeat silence. Pure host-side
+    #: polling off the hot loop. 0 disables the watchdog.
+    watchdog_interval_s: float = 5.0
     #: "slot" = contiguous per-slot rows (max_batch x max_seq, simplest
     #: and fastest per step); "paged" = block-table indirection over a
     #: page pool (ops/paged_kv.py) — capacity decoupled from
@@ -520,6 +528,7 @@ class Engine:
 
         self._failed: str | None = None
         self._last_beat = time.time()
+        self._watchdog: Any = None  # StallWatchdog, started with start()
 
         if self.metrics is not None:
             self.attach_metrics(self.metrics)
@@ -626,7 +635,8 @@ class Engine:
                       "prefix_hits": 0, "spec_passes": 0,
                       "spec_accepted": 0, "spec_drafted": 0,
                       "spec_rows": 0, "preemptions": 0,
-                      "requeues": 0, "prefix_evictions": 0}
+                      "requeues": 0, "prefix_evictions": 0,
+                      "stalls": 0}
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> None:
@@ -636,8 +646,16 @@ class Engine:
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="gofr-engine")
         self._thread.start()
+        if self.config.watchdog_interval_s > 0 and self._watchdog is None:
+            from .observability import StallWatchdog
+            self._watchdog = StallWatchdog(
+                self, interval_s=self.config.watchdog_interval_s)
+            self._watchdog.start()
 
     def stop(self, join_timeout_s: float = 30.0) -> None:
+        watchdog, self._watchdog = self._watchdog, None
+        if watchdog is not None:
+            watchdog.stop()
         self._running = False
         # snapshot: concurrent stop() calls are legal (handler + app
         # shutdown hook), and another stopper may null self._thread
@@ -712,6 +730,8 @@ class Engine:
             # through _crash, so this is the only way to see a hang
             out["status"] = "DEGRADED"
             out["stalled_for_s"] = round(stalled_for, 1)
+        if self.stats.get("stalls"):
+            out["stalls"] = self.stats["stalls"]
         if self._failed:
             out["error"] = self._failed
         if self.recorder.enabled:
@@ -766,6 +786,9 @@ class Engine:
              "draft tokens offered to speculative verify"),
             ("app_engine_spec_accepted",
              "draft tokens accepted by speculative verify"),
+            ("app_engine_stalls",
+             "stall episodes escalated by the watchdog (work in "
+             "flight, no pass for stall_threshold_s)"),
         ):
             if metrics.get(name) is None:
                 metrics.new_counter(name, desc)

@@ -74,6 +74,36 @@ class FlightRecorder:
             out["last_pass_age_s"] = round(time.time() - last["t"], 3)
         return out
 
+    def fleet_summary(self) -> dict:
+        """Compact per-host digest attached to control-plane heartbeats
+        (serving/control_plane.py): p50/p95 pass duration, mean
+        occupancy, last queue depth, tokens/s — computed over the pass
+        ring, on the heartbeat thread, from fields already recorded.
+        The leader derives fleet skew and straggler gauges from these."""
+        passes = list(self._passes)
+        out: dict = {"passes_recorded": self._seq,
+                     "by_kind": dict(self._by_kind)}
+        durs = sorted(p["dur"] for p in passes
+                      if isinstance(p.get("dur"), (int, float)))
+        if durs:
+            out["pass_p50_s"] = round(durs[int(0.5 * (len(durs) - 1))], 6)
+            out["pass_p95_s"] = round(durs[int(0.95 * (len(durs) - 1))], 6)
+        occ = [p["occupancy"] for p in passes
+               if isinstance(p.get("occupancy"), (int, float))]
+        if occ:
+            out["occupancy_mean"] = round(sum(occ) / len(occ), 3)
+        depths = [p["queue_depth"] for p in passes
+                  if isinstance(p.get("queue_depth"), (int, float))]
+        if depths:
+            out["queue_depth"] = depths[-1]
+        timed = [p for p in passes if "tokens" in p]
+        if len(timed) >= 2:
+            span = timed[-1]["t"] - timed[0]["t"]
+            if span > 0:
+                out["tokens_per_s"] = round(
+                    sum(p["tokens"] for p in timed[1:]) / span, 2)
+        return out
+
     def dump(self, logger: Any, reason: str = "") -> None:
         """Post-mortem: the ring is exactly what you want to see after
         a crash — the last N passes before the loop died."""
@@ -144,6 +174,89 @@ def emit_engine_spans(tracer: Any, req: Any) -> None:
     tracer.emit_span("engine.retire", trace_id=trace_id,
                      parent_id=root.span_id, start_time=end, end_time=end,
                      attributes={"error": req.error or ""})
+
+
+# ----------------------------------------------------------- watchdog
+class StallWatchdog:
+    """Promotes the engine's PASSIVE stall flag into action.
+
+    ``Engine.health_check()`` flips to DEGRADED when work is in flight
+    but no pass has completed for ``stall_threshold_s`` — but nothing
+    reads that unless an orchestrator happens to poll. This thread
+    polls it on the worker itself and, once per stall episode:
+
+    - dumps the flight recorder through the logger (the last N passes
+      before the hang are the post-mortem),
+    - emits an ``engine.stall`` span and bumps the
+      ``app_engine_stalls`` counter + ``stats["stalls"]``,
+
+    after which the next control-plane heartbeat (whose health source
+    is this same ``health_check``) reports DEGRADED and the leader can
+    evict + re-rank survivors instead of waiting for heartbeat silence.
+
+    Everything runs on this thread against host-side state — the hot
+    loop is never touched (zero-perturbation invariant). Re-arms when
+    the engine recovers, so a flapping device reports each episode.
+    """
+
+    def __init__(self, engine: Any, interval_s: float = 5.0) -> None:
+        self.engine = engine
+        self.interval_s = max(0.05, float(interval_s))
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._escalated = False
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="engine-watchdog")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(self.interval_s + 1.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.check_once()
+            except Exception:  # a broken check must not kill the thread
+                pass
+
+    def check_once(self) -> bool:
+        """One poll; returns True when a stall was escalated."""
+        engine = self.engine
+        health = engine.health_check()
+        stalled = (health.get("status") == "DEGRADED"
+                   and "stalled_for_s" in health)
+        if not stalled:
+            self._escalated = False
+            return False
+        if self._escalated:
+            return False  # already reported this episode
+        self._escalated = True
+        stalled_for = health.get("stalled_for_s")
+        engine.stats["stalls"] = engine.stats.get("stalls", 0) + 1
+        if engine.logger is not None:
+            engine.logger.error(
+                "engine stalled: work in flight but no pass for "
+                f"{stalled_for}s", active=health.get("active_slots"),
+                waiting=health.get("waiting"))
+        engine.recorder.dump(engine.logger,
+                             reason=f"stall: no pass for {stalled_for}s")
+        if engine.metrics is not None:
+            engine.metrics.increment_counter("app_engine_stalls")
+        tracer = getattr(engine, "tracer", None)
+        if tracer is not None:
+            tracer.start_span("engine.stall", attributes={
+                "stalled_for_s": stalled_for,
+                "active_slots": health.get("active_slots"),
+                "waiting": health.get("waiting")}).end()
+        return True
 
 
 # ------------------------------------------------------------------- MFU

@@ -22,7 +22,8 @@ from contextvars import ContextVar
 from dataclasses import dataclass, field
 from typing import Any, Protocol
 
-from ..logging.logger import reset_trace_context, set_trace_context
+from ..logging.logger import (current_fleet_context, reset_trace_context,
+                              set_trace_context)
 
 _current_span: ContextVar["Span | None"] = ContextVar("gofr_current_span", default=None)
 
@@ -130,14 +131,26 @@ class SpanExporter(Protocol):
 
 
 class InMemoryExporter:
-    """Collects finished spans; the test-side exporter."""
+    """Collects finished spans; the test-side exporter.
 
-    def __init__(self) -> None:
+    Bounded: a long-lived app wired to this exporter (TRACE_EXPORTER=
+    memory left on in a deployment) must not grow without limit — the
+    newest ``max_spans`` are kept in a ring and evictions are counted
+    in ``dropped`` so a truncated capture is visible, never silent."""
+
+    def __init__(self, max_spans: int = 8192) -> None:
+        self.max_spans = max(1, int(max_spans))
         self.spans: list[Span] = []
+        self.dropped = 0
         self._lock = threading.Lock()
 
     def export(self, span: Span) -> None:
         with self._lock:
+            if len(self.spans) >= self.max_spans:
+                # evict oldest; O(n) but only ever at the cap, and
+                # this exporter is a debugging/test surface
+                del self.spans[0]
+                self.dropped += 1
             self.spans.append(span)
 
 
@@ -186,10 +199,23 @@ class Tracer:
             sampled = self.ratio >= 1.0 or _sample_rng.random() < self.ratio
         span = Span(name=name, trace_id=trace_id, span_id=_rand_hex(8),
                     parent_id=parent_id, start_time=time.time(), tracer=self,
-                    sampled=sampled, attributes=dict(attributes or {}))
+                    sampled=sampled,
+                    attributes=self._with_resource(attributes))
         span._ctx_token = _current_span.set(span)
         span._log_token = set_trace_context(span.trace_id, span.span_id)
         return span
+
+    @staticmethod
+    def _with_resource(attributes: dict[str, Any] | None) -> dict[str, Any]:
+        """Resource attributes for every span: the process-wide fleet
+        context (host_id/rank/generation, set at control-plane join)
+        under the explicit attrs — a cross-host trace tells you which
+        host each span ran on without any per-callsite plumbing."""
+        fleet = current_fleet_context()
+        if not fleet:
+            return dict(attributes or {})
+        fleet.update(attributes or {})
+        return fleet
 
     def emit_span(self, name: str, *, trace_id: str,
                   parent_id: str | None = None, start_time: float,
@@ -206,7 +232,8 @@ class Tracer:
         span = Span(name=name, trace_id=trace_id, span_id=_rand_hex(8),
                     parent_id=parent_id, start_time=start_time,
                     tracer=self, sampled=True,
-                    attributes=dict(attributes or {}), status=status)
+                    attributes=self._with_resource(attributes),
+                    status=status)
         span.end_time = end_time
         self._export(span)
         return span

@@ -1,0 +1,200 @@
+"""CI smoke: a leader plus two in-process workers federate for real.
+
+Boots a LEADER App with the control plane installed and TWO worker
+Apps, each serving a tiny engine and joining the leader
+(``app.join_fleet``: health + flight summary + metrics snapshot ride
+every heartbeat). Drives one chat request per worker, then scrapes the
+leader's ``/control/fleet/metrics`` and asserts:
+
+- host/rank-labeled engine series are present for both workers;
+- federated counters equal the sum of the per-worker values;
+- ``/debug/fleet`` shows per-host flight summaries, skew and the
+  generation.
+
+Exits nonzero on any failure; one line per check on success.
+"""
+
+import asyncio
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from gofr_tpu.app import App
+from gofr_tpu.config import DictConfig
+from gofr_tpu.serving.engine import EngineConfig
+from gofr_tpu.serving.glue import demo_llama_engine
+from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+WORKERS = ("worker-0", "worker-1")
+
+
+def request(port: int, method: str, path: str, body=None, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    headers = dict(headers or {})
+    if isinstance(body, dict):
+        body = json.dumps(body)
+        headers.setdefault("Content-Type", "application/json")
+    try:
+        conn.request(method, path, body=body, headers=headers)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def parse_prom(text: str) -> dict[str, float]:
+    """{'name{labels}': value} with labels kept verbatim."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name_part, _, value = line.rpartition(" ")
+        try:
+            out[name_part] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+class AppThread:
+    """Boot an App on its own event loop thread (ephemeral ports)."""
+
+    def __init__(self, app: App) -> None:
+        self.app = app
+        self.loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self.loop)
+
+        async def main_coro():
+            await self.app.start()
+            self._started.set()
+            await self.app._stop_event.wait()
+
+        self.loop.run_until_complete(main_coro())
+
+    def start(self) -> "AppThread":
+        self._thread.start()
+        if not self._started.wait(60):
+            raise TimeoutError("app did not start")
+        return self
+
+    def stop(self) -> None:
+        asyncio.run_coroutine_threadsafe(
+            self.app.stop(), self.loop).result(30)
+        self._thread.join(10)
+
+    @property
+    def port(self) -> int:
+        return self.app.http_server.bound_port
+
+
+def make_app(name: str) -> App:
+    return App(config=DictConfig({
+        "HTTP_PORT": "0", "METRICS_PORT": "0", "APP_NAME": name,
+        "TRACE_EXPORTER": "memory", "GOFR_TELEMETRY": "false"}))
+
+
+def main() -> int:
+    leader_app = make_app("fleet-leader")
+    leader = leader_app.serve_fleet_leader(host_id="leader")
+    leader_thread = AppThread(leader_app).start()
+    leader_url = f"http://127.0.0.1:{leader_thread.port}"
+
+    workers = []
+    for host in WORKERS:
+        app = make_app(host)
+        engine = demo_llama_engine(EngineConfig(
+            max_batch=4, max_seq=128, seed=0, watchdog_interval_s=1.0))
+        app.serve_model("llm", engine, ByteTokenizer())
+        app.join_fleet(leader_url, host_id=host,
+                       heartbeat_interval_s=0.2)
+        workers.append((host, AppThread(app).start()))
+
+    try:
+        # one chat request per worker so the engine surface has samples
+        for host, thread in workers:
+            status, data = request(
+                thread.port, "POST", "/chat",
+                {"prompt": f"fleet smoke {host}", "max_tokens": 8,
+                 "temperature": 0.0})
+            assert status == 201, (host, status, data[:200])
+        print("ok: /chat 201 on both workers")
+
+        # wait for a post-request heartbeat from every worker
+        deadline = time.time() + 30
+        fleet = None
+        while time.time() < deadline:
+            status, data = request(leader_thread.port, "GET",
+                                   "/debug/fleet")
+            assert status == 200, status
+            fleet = json.loads(data)["data"]
+            hosts = fleet.get("hosts", {})
+            if all(h in hosts and hosts[h]["federated"]
+                   and hosts[h]["summary"].get("passes_recorded", 0) > 0
+                   for h in WORKERS):
+                break
+            time.sleep(0.2)
+        hosts = fleet["hosts"]
+        assert set(WORKERS) <= set(hosts), hosts.keys()
+        assert fleet["generation"] >= 2 and fleet["world_size"] == 2
+        for h in WORKERS:
+            summary = hosts[h]["summary"]
+            assert summary.get("passes_recorded", 0) > 0, (h, summary)
+            assert "pass_p95_s" in summary or "pass_p50_s" in summary, \
+                (h, summary)
+        assert "pass_skew" in fleet["fleet"], fleet["fleet"]
+        print(f"ok: /debug/fleet (generation={fleet['generation']}, "
+              f"skew={fleet['fleet']['pass_skew']})")
+
+        status, data = request(leader_thread.port, "GET",
+                               "/control/fleet/metrics")
+        assert status == 200, status
+        series = parse_prom(data.decode())
+        ranks = {h: hosts[h]["rank"] for h in WORKERS}
+        for name in ("app_engine_active_slots",
+                     "app_engine_tokens_per_second",
+                     "app_chat_ttft_seconds_count"):
+            for h in WORKERS:
+                key = f'{name}{{host="{h}",rank="{ranks[h]}"}}'
+                assert key in series, (key, sorted(
+                    k for k in series if k.startswith(name))[:4])
+        print("ok: host/rank-labeled engine series for both workers")
+
+        # federated counters equal the sum of per-worker values
+        per_worker = []
+        for _host, thread in workers:
+            manager = thread.app.container.metrics
+            per_worker.append(
+                manager.get("app_chat_ttft_seconds").get_count())
+        fed_total = sum(v for k, v in series.items()
+                        if k.startswith('app_chat_ttft_seconds_count{'))
+        assert fed_total == sum(per_worker) > 0, \
+            (fed_total, per_worker)
+        print(f"ok: federated counter sum matches per-worker values "
+              f"({fed_total})")
+
+        assert "app_fleet_generation" in series \
+            and "app_fleet_pass_skew" in series, "fleet gauges missing"
+        print("ok: app_fleet_* gauges on the federated scrape")
+    except AssertionError as exc:
+        print(f"FAIL: {exc}", file=sys.stderr)
+        return 1
+    finally:
+        for _host, thread in workers:
+            thread.stop()
+        leader_thread.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

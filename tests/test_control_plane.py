@@ -164,3 +164,99 @@ def test_topology_route_over_http():
         topo = body["data"]
         assert topo["world_size"] == 1
         assert topo["members"]["x"]["rank"] == 0
+
+
+# ------------------------------------------- failure-path transitions
+# (fleet metrics/generation counters asserted through each one)
+
+def _fleet_gauge(leader, name, **labels):
+    return leader.metrics.get(name).get(**labels)
+
+
+def test_stale_generation_rejoin_moves_generation_counters():
+    """An evicted host's next heartbeat is a 409 -> automatic rejoin;
+    the generation gauge tracks every bump (evict + rejoin) and the
+    eviction counter records the reason."""
+    leader, build = make_leader()
+    with AppRunner(build=build) as runner:
+        a = agent(runner, "a")
+        b = agent(runner, "b")
+        a.join()
+        b.join()
+        assert _fleet_gauge(leader, "app_fleet_generation") == 2.0
+        assert _fleet_gauge(leader, "app_fleet_world_size") == 2.0
+        leader.evict("a", reason="manual")
+        assert _fleet_gauge(leader, "app_fleet_generation") == 3.0
+        assert _fleet_gauge(leader, "app_fleet_world_size") == 1.0
+        assert _fleet_gauge(leader, "app_fleet_evictions",
+                            reason="manual") == 1.0
+        a._heartbeat_once()      # 409 -> rejoin with a fresh assignment
+        assert a.assignment is not None
+        assert a.assignment.generation == 4
+        assert _fleet_gauge(leader, "app_fleet_generation") == 4.0
+        assert _fleet_gauge(leader, "app_fleet_world_size") == 2.0
+        # a worker heartbeating with a STALE generation number (but
+        # still a member) is told changed=True, no eviction involved
+        b.assignment.generation = 1
+        b._heartbeat_once()
+        assert b.assignment.generation == 4
+
+
+def test_eviction_then_regeneration_reranks_and_counts():
+    """Heartbeat-timeout eviction (the sweeper path): the survivor
+    re-ranks, and the eviction counter carries reason=heartbeat_timeout
+    — distinct from degraded/manual evictions."""
+    leader, build = make_leader(heartbeat_interval_s=0.1,
+                                eviction_misses=2)
+    with AppRunner(build=build) as runner:
+        live = agent(runner, "live")
+        dead = agent(runner, "dead")
+        live.start()
+        dead.join()              # joins, never heartbeats again
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if leader.topology()["world_size"] == 1 \
+                    and live.assignment.world_size == 1:
+                break
+            time.sleep(0.05)
+        live.stop()
+        assert leader.topology()["world_size"] == 1
+        assert live.assignment.rank == 0
+        assert _fleet_gauge(leader, "app_fleet_evictions",
+                            reason="heartbeat_timeout") == 1.0
+        assert _fleet_gauge(leader, "app_fleet_world_size") == 1.0
+        assert _fleet_gauge(leader, "app_fleet_generation") \
+            == leader.generation
+
+
+def test_degraded_heartbeat_evicts_via_control_route():
+    """A heartbeat gossiping DEGRADED (the stall-watchdog escalation)
+    is evicted immediately over the HTTP route; DOWN keeps gossiping
+    (a dead engine stays visible, only a wedged one is cut)."""
+    from gofr_tpu.serving.control_plane import FleetConfig
+    leader, build = make_leader(fleet=FleetConfig(evict_degraded=True))
+    with AppRunner(build=build) as runner:
+        state = {"status": "UP"}
+        w = agent(runner, "w", health_source=lambda: dict(state))
+        other = agent(runner, "other")
+        w.join()
+        other.join()
+        generation = leader.generation
+        state["status"] = "DEGRADED"
+        state["stalled_for_s"] = 42.0
+        w._heartbeat_once()
+        assert w.assignment is None
+        assert leader.generation == generation + 1
+        assert leader.topology()["world_size"] == 1
+        assert _fleet_gauge(leader, "app_fleet_evictions",
+                            reason="degraded") == 1.0
+        other._heartbeat_once()
+        assert other.assignment.rank == 0
+        # DOWN gossip does NOT evict (observability, not amputation)
+        state["status"] = "DOWN"
+        del state["stalled_for_s"]
+        w.join()                 # operator-forced rejoin works
+        w._heartbeat_once()
+        assert w.assignment is not None
+        assert leader.topology()["world_size"] == 2
+        assert leader.health_check()["status"] == "DEGRADED"
