@@ -138,9 +138,15 @@ class App:
         from .http.auth import BasicAuthProvider
         self._install_auth(BasicAuthProvider(validator=validator), "Basic")
 
-    def enable_api_key_auth(self, *keys: str) -> None:
+    def enable_api_key_auth(self, *keys: str,
+                            key_names: dict[str, str] | None = None) -> None:
+        """Install API-key auth. ``key_names`` maps key -> tenant label
+        (the accounting identity usage metering reports under); keys
+        only ever surface downstream as short fingerprints."""
         from .http.auth import APIKeyAuthProvider
-        self._install_auth(APIKeyAuthProvider(list(keys)), "ApiKey")
+        self._install_auth(APIKeyAuthProvider(list(keys),
+                                              key_names=key_names),
+                           "ApiKey")
 
     def enable_api_key_auth_with_validator(self, validator: Callable) -> None:
         from .http.auth import APIKeyAuthProvider
@@ -250,9 +256,14 @@ class App:
         return run_migrations(self.container, migrations)
 
     def serve_model(self, name: str, engine, tokenizer=None, *,
-                    chat_path: str | None = "/chat") -> None:
+                    chat_path: str | None = "/chat",
+                    slo=None) -> None:
         """Wire a serving engine into the app: metrics, health, lifecycle,
-        and (optionally) a chat endpoint, in one call."""
+        and (optionally) a chat endpoint, in one call. ``slo`` is an
+        optional :class:`~gofr_tpu.serving.observability.SLOConfig`;
+        by default the engine gets a tracker with the stock objectives
+        (burn-rate gauges + ``GET /debug/slo``); pass a config to tune
+        thresholds, or construct/clear ``engine.slo`` yourself."""
         if hasattr(engine, "attach_metrics"):
             engine.attach_metrics(self.container.metrics)
         else:
@@ -262,6 +273,17 @@ class App:
         # the submitting request's HTTP/gRPC span through this tracer
         if getattr(engine, "tracer", None) is None:
             engine.tracer = self.container.tracer
+        # usage metering + SLO tracking: host-side accounting fed at
+        # retire (serving/observability.py) — series land on the
+        # container manager the engine was just attached to
+        ledger = getattr(engine, "usage_ledger", None)
+        if ledger is not None and ledger.metrics is None:
+            ledger.metrics = self.container.metrics
+        if hasattr(engine, "slo") and engine.slo is None:
+            from .serving.observability import SLOConfig, SLOTracker
+            engine.slo = SLOTracker(slo or SLOConfig(),
+                                    metrics=self.container.metrics,
+                                    logger=self.logger)
         self.container.add_model(name, engine)
         self._install_debug_routes()
         if self.container.tpu is None:
@@ -355,6 +377,34 @@ class App:
             return out
         self.get("/debug/engine", engine_debug)
 
+        def usage_debug(ctx):
+            """Per-tenant usage rollup: ``?tenant=`` filters,
+            ``?window=5m`` sums over the recent-event ring instead of
+            the cumulative totals."""
+            from .serving.observability import parse_window
+            tenant = ctx.param("tenant") or None
+            try:
+                window_s = parse_window(ctx.param("window") or None)
+            except ValueError:
+                from .http.errors import ErrorInvalidParam
+                raise ErrorInvalidParam("window")
+            out = {}
+            for model_name, engine in container.models.items():
+                ledger = getattr(engine, "usage_ledger", None)
+                out[model_name] = ledger.rollup(
+                    tenant=tenant, window_s=window_s) \
+                    if ledger is not None else None
+            return out
+        self.get("/debug/usage", usage_debug)
+
+        def slo_debug(ctx):
+            out = {}
+            for model_name, engine in container.models.items():
+                slo = getattr(engine, "slo", None)
+                out[model_name] = slo.state() if slo is not None else None
+            return out
+        self.get("/debug/slo", slo_debug)
+
         enabled = self.config.get_bool("PROFILER_ENABLED", False) \
             if hasattr(self.config, "get_bool") else False
         if not enabled:
@@ -390,7 +440,9 @@ class App:
                                   self.request_timeout)
         middlewares = [
             tracer_middleware(self.container.tracer),
-            logging_middleware(self.logger),
+            logging_middleware(
+                self.logger,
+                tenant_resolver=self.container.tenant_resolver),
             cors_middleware(self.config),
             metrics_middleware(self.container.metrics),
         ]
@@ -410,10 +462,21 @@ class App:
                 self.container.metrics.set_gauge(
                     "app_uptime_seconds",
                     round(time.time() - self.container._start_time, 1))
-                text = self.container.metrics.render_prometheus()
+                # content negotiation: a scraper asking for OpenMetrics
+                # (Prometheus does when exemplar storage is on) gets
+                # the exemplar-bearing exposition; everyone else gets
+                # the classic text format, byte-identical to before
+                accept = request.header("accept") \
+                    if hasattr(request, "header") else ""
+                if "application/openmetrics-text" in (accept or ""):
+                    text = self.container.metrics.render_openmetrics()
+                    ctype = ("application/openmetrics-text; "
+                             "version=1.0.0; charset=utf-8")
+                else:
+                    text = self.container.metrics.render_prometheus()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
                 return ResponseData(
-                    status=200, body=text.encode(),
-                    content_type="text/plain; version=0.0.4; charset=utf-8")
+                    status=200, body=text.encode(), content_type=ctype)
             if request.path == "/.well-known/alive":
                 return ResponseData(status=200, body=b'{"status": "UP"}')
             return ResponseData(status=404, body=b"not found",

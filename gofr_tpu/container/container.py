@@ -15,6 +15,7 @@ import time
 from typing import Any
 
 from ..config.env import DictConfig
+from ..http.auth import TenantResolver
 from ..logging.logger import Logger, level_from_string, new_logger
 from ..metrics.registry import Manager as MetricsManager
 from ..tracing.tracer import ConsoleExporter, InMemoryExporter, Tracer
@@ -47,6 +48,10 @@ class Container:
         self.app_version = "dev"
         self.metrics: MetricsManager = MetricsManager(self.logger)
         self.tracer: Tracer = Tracer(service_name=self.app_name)
+        # auth principal -> bounded accounting label; shared by the
+        # request-log middleware and every serving handler so one
+        # request resolves to ONE tenant everywhere
+        self.tenant_resolver = TenantResolver()
         self.services: dict[str, Any] = {}   # name -> service.HTTPService
         self.pubsub: Any = None              # pubsub client
         self.sql: Any = None                 # SQL datasource
@@ -244,6 +249,32 @@ class Container:
                       "(by reason label)")
         m.new_counter("app_fleet_heartbeats",
                       "control-plane heartbeats received")
+        # tenant metering + SLO series, written by the usage ledger /
+        # SLO tracker (serving/observability.py) at request retire;
+        # tenant-labeled counters SUM across hosts under federation
+        m.new_counter("app_tenant_requests",
+                      "retired requests by tenant and status "
+                      "(ok/error/cancelled)")
+        m.new_counter("app_tenant_prompt_tokens",
+                      "prompt tokens by tenant")
+        m.new_counter("app_tenant_completion_tokens",
+                      "generated tokens by tenant")
+        m.new_counter("app_tenant_device_seconds",
+                      "device busy time attributed to each tenant "
+                      "(per-request share of every pass's busy span)")
+        m.new_histogram("app_tenant_queue_seconds",
+                        "admission queue wait by tenant",
+                        buckets=latency_buckets)
+        m.new_histogram("app_tenant_e2e_seconds",
+                        "submit -> finish wall time by tenant",
+                        buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1,
+                                 2.5, 5, 10, 30, 60))
+        m.new_gauge("app_slo_burn_rate",
+                    "error-budget burn rate by window (1 = spending "
+                    "the budget at exactly the sustainable pace)")
+        m.new_gauge("app_slo_error_budget_remaining",
+                    "fraction of the availability error budget left "
+                    "over SLOConfig.budget_window_s")
 
     # ------------------------------------------------------------- health
     def health(self) -> dict[str, Any]:

@@ -36,6 +36,87 @@ class AuthProvider(Protocol):
     def authenticate(self, request: HTTPRequest) -> Any: ...
 
 
+def credential_fingerprint(secret: str) -> str:
+    """Short stable hash standing in for a raw credential anywhere it
+    could be logged, traced or used as a metric label. Long enough to
+    correlate a tenant across restarts, far too short to recover or
+    use as the key."""
+    return hashlib.sha256(secret.encode()).hexdigest()[:12]
+
+
+class TenantResolver:
+    """Auth principal -> bounded-cardinality tenant label.
+
+    The accounting identity for usage metering and per-tenant metrics:
+    maps whatever an auth provider attached (``ctx.auth_info``) to one
+    short string safe to use as a Prometheus label. Resolution order:
+
+    - ``tenant`` key (set by ``APIKeyAuthProvider(key_names=...)``)
+    - basic-auth ``username``
+    - JWT claims: the first of ``claim_keys`` (default ``org`` then
+      ``sub``)
+    - hashed ``api_key`` (providers already store the fingerprint,
+      never the raw key)
+    - anything else hashes into a ``t-<fingerprint>`` bucket; an empty
+      principal is ``anonymous``.
+
+    Cardinality is HARD-bounded: after ``max_tenants`` distinct labels
+    have been seen, new ones collapse to ``other`` — a credential
+    stuffing run cannot blow up the label space. Labels are
+    sanitized to ``[A-Za-z0-9_.:-]`` and capped at 64 chars.
+    """
+
+    OTHER = "other"
+    ANONYMOUS = "anonymous"
+
+    def __init__(self, max_tenants: int = 256,
+                 claim_keys: tuple = ("org", "sub")) -> None:
+        self.max_tenants = max(1, int(max_tenants))
+        self.claim_keys = tuple(claim_keys)
+        self._seen: set[str] = set()
+        self._lock = __import__("threading").Lock()
+
+    @staticmethod
+    def _sanitize(label: str) -> str:
+        clean = "".join(c if (c.isalnum() or c in "_.:-") else "_"
+                        for c in str(label))
+        return clean[:64] or TenantResolver.ANONYMOUS
+
+    def label_for(self, info: Mapping[str, Any] | None) -> str:
+        """Raw label before the cardinality bound."""
+        if not info:
+            return self.ANONYMOUS
+        if info.get("tenant"):
+            return self._sanitize(info["tenant"])
+        if info.get("username"):
+            return self._sanitize(info["username"])
+        claims = info.get("claims")
+        if isinstance(claims, Mapping):
+            for key in self.claim_keys:
+                if claims.get(key):
+                    return self._sanitize(claims[key])
+        if info.get("api_key"):
+            # providers store the fingerprint; label it recognizably
+            return self._sanitize(f"key-{info['api_key']}")
+        # unknown principal shape: a stable hashed bucket, never the
+        # repr (which could leak credentials into labels)
+        try:
+            basis = json.dumps(info, sort_keys=True, default=str)
+        except (TypeError, ValueError):
+            basis = str(sorted(info))
+        return f"t-{credential_fingerprint(basis)}"
+
+    def resolve(self, info: Mapping[str, Any] | None) -> str:
+        label = self.label_for(info)
+        with self._lock:
+            if label in self._seen:
+                return label
+            if len(self._seen) >= self.max_tenants:
+                return self.OTHER
+            self._seen.add(label)
+        return label
+
+
 def _unauthorized(message: str = "Unauthorized",
                   scheme: str = "Basic") -> ResponseData:
     body = json.dumps({"error": {"message": message}}).encode()
@@ -118,12 +199,27 @@ class BasicAuthProvider:
 class APIKeyAuthProvider:
     """Static key set or custom validator (reference apikey_auth.go:89).
 
-    Keys ride in the ``X-Api-Key`` header."""
+    Keys ride in the ``X-Api-Key`` header. The raw key NEVER reaches
+    the principal: ``auth_info["api_key"]`` carries its
+    :func:`credential_fingerprint`, so nothing downstream (logs,
+    spans, metric labels, /debug surfaces) can leak it. An optional
+    ``key_names`` mapping (key -> tenant label) additionally stamps a
+    human-chosen ``tenant`` into the principal — the label the tenant
+    resolver and usage ledger account under."""
 
     def __init__(self, keys: list[str] | None = None,
-                 validator: Callable[[str], bool | Awaitable[bool]] | None = None) -> None:
-        self.keys = set(keys or [])
+                 validator: Callable[[str], bool | Awaitable[bool]] | None = None,
+                 key_names: Mapping[str, str] | None = None) -> None:
+        self.keys = set(keys or []) | set(key_names or {})
         self.validator = validator
+        self.key_names = dict(key_names or {})
+
+    def _info(self, key: str) -> dict:
+        info = {"api_key": credential_fingerprint(key)}
+        name = self.key_names.get(key)
+        if name:
+            info["tenant"] = name
+        return info
 
     def authenticate(self, request: HTTPRequest) -> dict | None:
         key = request.header("x-api-key")
@@ -133,12 +229,12 @@ class APIKeyAuthProvider:
             result = self.validator(key)
             if asyncio.iscoroutine(result):
                 async def check():
-                    return {"api_key": key} if await result else None
+                    return self._info(key) if await result else None
                 return check()  # type: ignore[return-value]
-            return {"api_key": key} if result else None
+            return self._info(key) if result else None
         if any(hmac.compare_digest(key.encode(), k.encode())
                for k in self.keys):
-            return {"api_key": key}
+            return self._info(key)
         return None
 
 

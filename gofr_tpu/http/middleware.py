@@ -59,7 +59,8 @@ def tracer_middleware(tracer) -> Middleware:
     return mw
 
 
-def logging_middleware(logger: Logger) -> Middleware:
+def logging_middleware(logger: Logger,
+                       tenant_resolver=None) -> Middleware:
     def mw(next_handler: Handler) -> Handler:
         async def wrapped(request: HTTPRequest) -> ResponseData:
             start = time.perf_counter()
@@ -78,6 +79,13 @@ def logging_middleware(logger: Logger) -> Middleware:
                     request.method, request.path, response.status,
                     int((time.perf_counter() - start) * 1e6),
                     request.client_addr, trace_id)
+                # the auth middleware runs INSIDE this one, so by now
+                # the principal (if any) is on the request — stamp the
+                # resolved tenant label into the request log so one
+                # grep answers "who was hitting this route"
+                info = getattr(request, "auth_info", None)
+                if tenant_resolver is not None and info:
+                    record.tenant = tenant_resolver.resolve(info)
                 if response.status >= 500:
                     logger.error(record)
                 else:

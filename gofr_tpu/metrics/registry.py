@@ -24,7 +24,10 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 from typing import Iterable, Mapping
+
+from ..logging.logger import current_trace_ids
 
 DEFAULT_BUCKETS = (0.001, 0.003, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                    0.5, 0.75, 1, 2, 3, 5, 10, 30)
@@ -124,8 +127,24 @@ class Histogram(_Metric):
         self.buckets = tuple(sorted(buckets))
         # labelset -> (bucket_counts, sum, count)
         self._hist: dict[tuple[tuple[str, str], ...], tuple[list[int], float, int]] = {}
+        # labelset -> per-bucket latest exemplar (trace_id, value, ts);
+        # index len(buckets) is the +Inf bucket. Memory is bounded by
+        # labelsets x (buckets + 1); rendered only on the OpenMetrics
+        # content-negotiated path, so plain Prometheus output is
+        # byte-identical with exemplars on or off.
+        self._exemplars: dict[tuple[tuple[str, str], ...],
+                              list[tuple[str, float, float] | None]] = {}
 
-    def record(self, value: float, labels: Mapping[str, str]) -> None:
+    def record(self, value: float, labels: Mapping[str, str],
+               trace_id: str | None = None) -> None:
+        """Record an observation; optionally capture an exemplar trace
+        id. ``trace_id=None`` falls back to the active request's trace
+        (the logging contextvar the tracer middleware sets) — a cheap
+        host-side read; call sites off any request context (the engine
+        thread) pass the retired request's own trace id explicitly."""
+        if trace_id is None:
+            ids = current_trace_ids()
+            trace_id = ids[0] if ids else None
         key = _labels_key(labels)
         with self._lock:
             counts, total, n = self._hist.get(key, ([0] * len(self.buckets), 0.0, 0))
@@ -133,6 +152,14 @@ class Histogram(_Metric):
                 if value <= b:
                     counts[i] += 1
             self._hist[key] = (counts, total + value, n + 1)
+            if trace_id:
+                ex = self._exemplars.get(key)
+                if ex is None:
+                    ex = [None] * (len(self.buckets) + 1)
+                    self._exemplars[key] = ex
+                idx = next((i for i, b in enumerate(self.buckets)
+                            if value <= b), len(self.buckets))
+                ex[idx] = (trace_id, value, time.time())
 
     def get_count(self, **labels: str) -> int:
         # under _lock: a concurrent record() replaces the entry tuple
@@ -156,16 +183,41 @@ class Histogram(_Metric):
                 "buckets": list(self.buckets), "series": series}
 
     def render(self) -> Iterable[str]:
+        yield from self._render(exemplars=False)
+
+    def render_openmetrics(self) -> Iterable[str]:
+        """Same exposition plus OpenMetrics exemplars on bucket lines:
+        ``name_bucket{le="..."} 7 # {trace_id="..."} 0.093 <ts>`` —
+        the hook a Grafana/Prometheus exemplar query follows from a
+        bad latency bucket straight to the ``engine.request`` trace."""
+        yield from self._render(exemplars=True)
+
+    def _render(self, exemplars: bool) -> Iterable[str]:
         yield f"# HELP {self.name} {self.description}"
         yield f"# TYPE {self.name} histogram"
         with self._lock:
             items = [(k, ([*c], s, n)) for k, (c, s, n) in self._hist.items()]
+            ex = {k: list(v) for k, v in self._exemplars.items()} \
+                if exemplars else {}
+
+        def tail(key: tuple, idx: int) -> str:
+            e = ex.get(key)
+            if not e or e[idx] is None:
+                return ""
+            trace_id, value, ts = e[idx]
+            return (f' # {{trace_id="{_escape(trace_id)}"}} '
+                    f"{_fmt_value(value)} {round(ts, 3)}")
+
         for key, (counts, total, n) in items:
-            for bucket, count in zip(self.buckets, counts):
+            for i, (bucket, count) in enumerate(zip(self.buckets, counts)):
                 bkey = key + (("le", _fmt_value(float(bucket))),)
-                yield f"{self.name}_bucket{_fmt_labels(tuple(sorted(bkey)))} {count}"
+                yield (f"{self.name}_bucket"
+                       f"{_fmt_labels(tuple(sorted(bkey)))} {count}"
+                       + tail(key, i))
             inf_key = key + (("le", "+Inf"),)
-            yield f"{self.name}_bucket{_fmt_labels(tuple(sorted(inf_key)))} {n}"
+            yield (f"{self.name}_bucket"
+                   f"{_fmt_labels(tuple(sorted(inf_key)))} {n}"
+                   + tail(key, len(self.buckets)))
             yield f"{self.name}_sum{_fmt_labels(key)} {_fmt_value(total)}"
             yield f"{self.name}_count{_fmt_labels(key)} {n}"
 
@@ -230,10 +282,12 @@ class Manager:
         if m is not None:
             m._bump(delta, labels)
 
-    def record_histogram(self, name: str, value: float, **labels: str) -> None:
+    def record_histogram(self, name: str, value: float, *,
+                         exemplar_trace_id: str | None = None,
+                         **labels: str) -> None:
         m = self._lookup(name, Histogram)
         if m is not None:
-            m.record(value, labels)
+            m.record(value, labels, trace_id=exemplar_trace_id)
 
     def set_gauge(self, name: str, value: float, **labels: str) -> None:
         m = self._lookup(name, Gauge)
@@ -257,6 +311,25 @@ class Manager:
                 continue
             lines.extend(m.render())
         return "\n".join(lines) + "\n" if lines else ""
+
+    def render_openmetrics(self, prefix: str | None = None) -> str:
+        """The ``application/openmetrics-text`` exposition: identical
+        families and samples to :meth:`render_prometheus`, plus
+        exemplars on histogram bucket lines and the ``# EOF``
+        terminator OpenMetrics parsers require. Served when a scraper
+        content-negotiates for it (the app's metrics handler)."""
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        lines: list[str] = []
+        for m in metrics:
+            if prefix is not None and not m.name.startswith(prefix):
+                continue
+            if isinstance(m, Histogram):
+                lines.extend(m.render_openmetrics())
+            else:
+                lines.extend(m.render())
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
 
     # -- federation
     def snapshot(self) -> dict:

@@ -445,9 +445,25 @@ class ControlPlaneLeader:
                 continue
             totals[name] = round(sum(float(s.get("value", 0.0))
                                      for s in fam["series"]), 6)
+        # the fleet answer to "who is burning my budget": per-tenant
+        # token/device totals summed across every member's heartbeat
+        # snapshot (counters with identical labelsets merge by sum)
+        tenant_usage: dict[str, dict[str, float]] = {}
+        for name in ("app_tenant_requests", "app_tenant_prompt_tokens",
+                     "app_tenant_completion_tokens",
+                     "app_tenant_device_seconds"):
+            fam = merged["metrics"].get(name)
+            if not fam:
+                continue
+            for s in fam.get("series", ()):
+                tenant = (s.get("labels") or {}).get("tenant", "unknown")
+                bucket = tenant_usage.setdefault(tenant, {})
+                bucket[name] = round(bucket.get(name, 0.0)
+                                     + float(s.get("value", 0.0)), 6)
         return {"generation": generation, "world_size": world,
                 "fleet": self._recompute_skew(), "hosts": hosts,
-                "counter_totals": totals}
+                "counter_totals": totals,
+                "tenant_usage": tenant_usage}
 
     def fleet_metrics_text(self) -> str:
         """The federated Prometheus exposition for

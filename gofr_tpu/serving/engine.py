@@ -97,6 +97,14 @@ class GenRequest:
     admitted_at: float | None = None  # first slot assignment (queue end)
     events: list = field(default_factory=list)  # (name, t0, t1, attrs)
     _obs_done: bool = False        # finalize-once guard (retire + fail)
+    tenant: str | None = None      # bounded tenant label from the auth
+                                   # principal (TenantResolver); stamped
+                                   # into spans/usage, accounted by the
+                                   # UsageLedger at retire
+    device_s: float = 0.0          # this request's share of each pass's
+                                   # busy span (busy/occupancy per pass,
+                                   # accumulated at collect — host float
+                                   # adds on an existing loop)
 
     def _emit(self, token: int | None) -> None:
         if self.out_queue is not None and self.loop is not None:
@@ -287,9 +295,16 @@ class Engine:
         #: host timestamps); None = no spans. ``app.serve_model`` wires
         #: the container's tracer here.
         self.tracer = tracer
-        from .observability import FlightRecorder
+        from .observability import FlightRecorder, UsageLedger
         self.recorder = FlightRecorder(config.flight_recorder_size,
                                        config.flight_recorder_requests)
+        #: per-tenant usage metering, fed at retire (_finalize_obs);
+        #: always present (host dicts only) — attach_metrics points it
+        #: at the metrics manager so app_tenant_* series populate
+        self.usage_ledger = UsageLedger()
+        #: SLO burn-rate tracker (serving/observability.SLOTracker);
+        #: wired by app.serve_model (or set directly) — None = off
+        self.slo = None
         #: MFU basis, derived once at compile time in warmup() from the
         #: decode graph's cost_analysis — None until then (gauge stays 0)
         self._flops_per_token: float | None = None
@@ -789,9 +804,28 @@ class Engine:
             ("app_engine_stalls",
              "stall episodes escalated by the watchdog (work in "
              "flight, no pass for stall_threshold_s)"),
+            ("app_tenant_requests",
+             "retired requests by tenant and status (ok/error/"
+             "cancelled)"),
+            ("app_tenant_prompt_tokens", "prompt tokens by tenant"),
+            ("app_tenant_completion_tokens",
+             "generated tokens by tenant"),
+            ("app_tenant_device_seconds",
+             "device busy time attributed to each tenant (per-request "
+             "share of every pass's busy span)"),
         ):
             if metrics.get(name) is None:
                 metrics.new_counter(name, desc)
+        for name, desc in (
+            ("app_slo_burn_rate",
+             "error-budget burn rate by window (1 = spending the "
+             "budget at exactly the sustainable pace)"),
+            ("app_slo_error_budget_remaining",
+             "fraction of the availability error budget left over "
+             "SLOConfig.budget_window_s"),
+        ):
+            if metrics.get(name) is None:
+                metrics.new_gauge(name, desc)
         ttft_buckets = (0.005, 0.01, 0.025, 0.05, 0.075, 0.1, 0.15,
                         0.25, 0.5, 1, 2, 5)
         for name, desc, buckets in (
@@ -813,9 +847,19 @@ class Engine:
             ("app_tpu_execute_seconds", "device execute wall time",
              (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
               0.25, 0.5, 1, 5)),
+            ("app_tenant_queue_seconds",
+             "admission queue wait by tenant", ttft_buckets),
+            ("app_tenant_e2e_seconds",
+             "submit -> finish wall time by tenant",
+             (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60)),
         ):
             if metrics.get(name) is None:
                 metrics.new_histogram(name, desc, buckets=buckets)
+        if self.usage_ledger is not None \
+                and self.usage_ledger.metrics is None:
+            self.usage_ledger.metrics = metrics
+        if self.slo is not None and self.slo.metrics is None:
+            self.slo.metrics = metrics
 
     def warmup(self, prompt_lens: tuple = (1,), decode: bool = True,
                chunked: bool = False) -> None:
@@ -933,18 +977,24 @@ class Engine:
     # -------------------------------------------------------------- submit
     def submit(self, prompt_tokens: list[int],
                params: SamplingParams | None = None, *,
-               traceparent: str | None = None) -> GenRequest:
+               traceparent: str | None = None,
+               tenant: str | None = None) -> GenRequest:
         """Called from the asyncio loop; returns a request whose
         ``out_queue`` yields token ids and then ``None``.
 
         When a tracer is attached, the request carries the caller's
         trace identity — the active span on the submitting thread/task
         (the HTTP/gRPC middleware span), else a W3C ``traceparent``
-        header — and the engine.* child spans assemble at retire."""
+        header — and the engine.* child spans assemble at retire.
+        ``tenant`` is the resolved bounded-cardinality accounting
+        label (handlers pass it from the auth principal); it rides the
+        request into spans, the flight-recorder log and the usage
+        ledger."""
         params = params or SamplingParams()
         prompt_tokens = self._clamp_prompt(list(prompt_tokens),
                                            params.max_new_tokens)
-        req = GenRequest(prompt_tokens=prompt_tokens, params=params)
+        req = GenRequest(prompt_tokens=prompt_tokens, params=params,
+                         tenant=tenant)
         if self.tracer is not None:
             parent = self.tracer.current_span()
             if parent is not None:
@@ -1179,7 +1229,8 @@ class Engine:
             req.first_token_at = now
             if self.metrics is not None:
                 self.metrics.record_histogram(
-                    "app_chat_ttft_seconds", now - req.submitted_at)
+                    "app_chat_ttft_seconds", now - req.submitted_at,
+                    exemplar_trace_id=req.trace[0] if req.trace else None)
         req.generated.append(first)
         req._emit(first)
         self.total_generated += 1
@@ -1324,15 +1375,17 @@ class Engine:
                         self.stats["prefill_calls"] += 1
                         if self._native_chunk:
                             self._note_view_avoided(G)
+                        c_dur = time.perf_counter() - c0
                         if self.recorder.enabled:
                             self.recorder.record_pass(
                                 "prefill_chunk", rows=len(ready),
                                 width=width,
-                                dur=round(time.perf_counter() - c0, 6),
+                                dur=round(c_dur, 6),
                                 view_avoided=self._native_chunk,
                                 queue_depth=self.waiting.qsize())
                         w1 = time.time()
                         for r in ready:
+                            r.device_s += c_dur / len(ready)
                             self._req_event(
                                 r, "prefill", w0, w1,
                                 {"bucket": width,
@@ -1629,7 +1682,8 @@ class Engine:
             req.admitted_at = now
             if self.metrics is not None:
                 self.metrics.record_histogram(
-                    "app_chat_queue_seconds", now - req.submitted_at)
+                    "app_chat_queue_seconds", now - req.submitted_at,
+                    exemplar_trace_id=req.trace[0] if req.trace else None)
 
     def _finalize_obs(self, req: GenRequest) -> None:
         """Terminal observability for a request (exactly once): latency
@@ -1641,15 +1695,35 @@ class Engine:
             return
         req._obs_done = True
         end = req.finished_at or time.time()
+        exemplar = req.trace[0] if req.trace else None
+        n = len(req.generated)
+        ttft_s = ((req.first_token_at - req.submitted_at)
+                  if req.first_token_at is not None else None)
+        tpot_s = ((end - req.first_token_at) / (n - 1)
+                  if req.first_token_at is not None and n > 1 else None)
+        e2e_s = end - req.submitted_at
         if self.metrics is not None and req.error is None \
                 and not req.cancelled:
-            self.metrics.record_histogram("app_chat_e2e_seconds",
-                                          end - req.submitted_at)
-            n = len(req.generated)
-            if req.first_token_at is not None and n > 1:
+            self.metrics.record_histogram("app_chat_e2e_seconds", e2e_s,
+                                          exemplar_trace_id=exemplar)
+            if tpot_s is not None:
                 self.metrics.record_histogram(
-                    "app_chat_tpot_seconds",
-                    (end - req.first_token_at) / (n - 1))
+                    "app_chat_tpot_seconds", tpot_s,
+                    exemplar_trace_id=exemplar)
+        if self.usage_ledger is not None:
+            status = ("cancelled" if req.cancelled
+                      else "error" if req.error is not None else "ok")
+            queue_s = ((req.admitted_at - req.submitted_at)
+                       if req.admitted_at is not None else 0.0)
+            self.usage_ledger.record(
+                tenant=req.tenant or "anonymous", status=status,
+                prompt_tokens=len(req.prompt_tokens),
+                completion_tokens=n, queue_s=queue_s, e2e_s=e2e_s,
+                device_s=req.device_s, t=end)
+        if self.slo is not None and not req.cancelled:
+            self.slo.record(self.slo.judge(
+                error=req.error, ttft_s=ttft_s, tpot_s=tpot_s,
+                e2e_s=e2e_s), t=end)
         if self.recorder.enabled:
             from .observability import request_summary
             self.recorder.record_request(request_summary(req))
@@ -1865,11 +1939,13 @@ class Engine:
                 continue
             self._note_prefill_span(rec["t0"])
             now = time.time()
+            pass_dur = time.perf_counter() - rec["t0"]
+            pass_share = pass_dur / max(1, len(rec["placed"]))
             if self.recorder.enabled:
                 self.recorder.record_pass(
                     "prefill", rows=len(rec["placed"]),
                     bucket=rec.get("bucket"),
-                    dur=round(time.perf_counter() - rec["t0"], 6),
+                    dur=round(pass_dur, 6),
                     occupancy=sum(r is not None for r in self.active),
                     queue_depth=self.waiting.qsize())
             for row, (req, slot, epoch) in enumerate(
@@ -1879,6 +1955,7 @@ class Engine:
                         or req.finished_at is not None):
                     continue  # preempted/retired/re-admitted since
                 req.pending_prefill = False
+                req.device_s += pass_share
                 self._req_event(req, "prefill", rec.get("wall0", now),
                                 now, {"bucket": rec.get("bucket"),
                                       "rows": len(rec["placed"])})
@@ -1888,7 +1965,9 @@ class Engine:
                     if self.metrics is not None:
                         self.metrics.record_histogram(
                             "app_chat_ttft_seconds",
-                            now - req.submitted_at)
+                            now - req.submitted_at,
+                            exemplar_trace_id=req.trace[0]
+                            if req.trace else None)
                 req.generated.append(first)
                 req._emit(first)
                 self.total_generated += 1
@@ -2198,11 +2277,16 @@ class Engine:
                                           float(occupancy))
         self._step_count += 1
         emitted = 0
+        share = busy / occupancy if occupancy else 0.0
         for i, req in enumerate(rec["reqs"]):
             if req is None or not rec["mask"][i]:
                 continue
             if self.active[i] is not req or req.finished_at is not None:
                 continue  # retired/preempted since dispatch: discard
+            # device-time attribution: this pass's busy span split
+            # evenly across its occupied rows — the per-tenant
+            # device_seconds the usage ledger accounts at retire
+            req.device_s += share
             done = False
             for k in range(int(rec["valid"][i])):
                 token = int(step_np[k, i])
@@ -2400,9 +2484,14 @@ class Engine:
         self._note_pass("spec_passes", start)
         w1 = time.time()
         pass_drafted = pass_accepted = pass_rows = 0
+        live = sum(1 for r in self.active
+                   if r is not None and not r.pending_prefill)
+        verify_share = ((time.perf_counter() - start) / live) if live \
+            else 0.0
         for i, req in enumerate(self.active):
             if req is None or req.pending_prefill:
                 continue
+            req.device_s += verify_share
             n_acc = int(accepted[i])
             n_drafted = len(proposals.get(i, []))
             pass_drafted += n_drafted

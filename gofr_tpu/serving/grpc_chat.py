@@ -42,6 +42,17 @@ def _params_from(req: dict) -> tuple[str, SamplingParams]:
         max_new_tokens=max_new)
 
 
+def _tenant_of(ctx: Any) -> str | None:
+    """Resolve the accounting label for a gRPC chat call — the same
+    TenantResolver the HTTP path uses, against whatever auth info the
+    context carries (an unauthenticated RPC lands on ``anonymous``)."""
+    resolver = getattr(getattr(ctx, "container", None),
+                       "tenant_resolver", None)
+    if resolver is None:
+        return None
+    return resolver.resolve(getattr(ctx, "auth_info", None))
+
+
 def make_chat_service(engine: Engine, tokenizer: Any) -> GRPCService:
     """Build the registered service instance for ``app.register_grpc``."""
 
@@ -53,11 +64,12 @@ def make_chat_service(engine: Engine, tokenizer: Any) -> GRPCService:
             prompt, params = _params_from(request or {})
             prompt_tokens = tokenizer.encode(prompt)
             start = time.perf_counter()
+            tenant = _tenant_of(ctx)
             # the gRPC server's per-RPC span is active on this task;
             # invocation metadata carries the raw header as fallback
             req = engine.submit(prompt_tokens, params,
                                 traceparent=ctx.header("traceparent")
-                                or None)
+                                or None, tenant=tenant)
             if req.error:
                 # admission refused: distinct status, not INTERNAL
                 exc = RuntimeError(req.error)
@@ -85,6 +97,7 @@ def make_chat_service(engine: Engine, tokenizer: Any) -> GRPCService:
                                  "ttft_ms": round(req.ttft_ms, 2)
                                  if req.ttft_ms else None,
                                  "tpot_ms": tpot_ms,
+                                 "tenant": tenant,
                                  "duration_ms": round(
                                      (time.perf_counter() - start) * 1e3,
                                      2)}}
@@ -98,9 +111,10 @@ def make_chat_service(engine: Engine, tokenizer: Any) -> GRPCService:
         async def Complete(self, ctx, request) -> dict:
             prompt, params = _params_from(request or {})
             prompt_tokens = tokenizer.encode(prompt)
+            tenant = _tenant_of(ctx)
             req = engine.submit(prompt_tokens, params,
                                 traceparent=ctx.header("traceparent")
-                                or None)
+                                or None, tenant=tenant)
             if req.error:
                 # same overload condition, same status as Stream
                 exc = RuntimeError(req.error)
@@ -118,6 +132,7 @@ def make_chat_service(engine: Engine, tokenizer: Any) -> GRPCService:
                     "usage": {"prompt_tokens": len(prompt_tokens),
                               "completion_tokens": len(tokens),
                               "ttft_ms": round(req.ttft_ms, 2)
-                              if req.ttft_ms else None}}
+                              if req.ttft_ms else None,
+                              "tenant": tenant}}
 
     return ChatService()

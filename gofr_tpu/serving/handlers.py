@@ -47,11 +47,18 @@ def make_chat_handler(engine: Engine, tokenizer: Any):
         prompt_tokens = tokenizer.encode(prompt)
         stream = bool(body.get("stream", False))
 
+        # tenant attribution: the auth principal (set by the auth
+        # middleware) resolves to a bounded accounting label that
+        # rides the request into spans, metrics and the usage ledger
+        resolver = getattr(ctx.container, "tenant_resolver", None)
+        tenant = resolver.resolve(ctx.auth_info) if resolver else None
+
         # the tracer middleware's span is active on this task, so the
         # engine picks the parent from the contextvar; the raw header
         # is the fallback for apps running without the middleware
         req = engine.submit(prompt_tokens, params,
-                            traceparent=ctx.header("traceparent") or None)
+                            traceparent=ctx.header("traceparent") or None,
+                            tenant=tenant)
         if req.error:
             # instant failure = admission refused, not a generation bug
             raise ErrorServiceUnavailable(req.error)
@@ -101,6 +108,7 @@ def make_chat_handler(engine: Engine, tokenizer: Any):
                 "completion_tokens": len(tokens),
                 "ttft_ms": round(req.ttft_ms, 2) if req.ttft_ms else None,
                 "tpot_ms": round(tpot_ms, 3) if tpot_ms else None,
+                "tenant": tenant,
             },
         }
 

@@ -1,5 +1,6 @@
 """Serving-path observability: flight recorder, engine trace assembly,
-on-demand profiler capture, MFU derivation.
+tenant usage metering, SLO burn-rate tracking, on-demand profiler
+capture, MFU derivation.
 
 Everything in this module is HOST-side bookkeeping over timestamps and
 counters the engine already collects. The hard invariant is **zero
@@ -21,6 +22,7 @@ import os
 import threading
 import time
 from collections import deque
+from dataclasses import dataclass
 from typing import Any
 
 
@@ -123,6 +125,8 @@ def request_summary(req: Any) -> dict:
         "prompt_tokens": len(req.prompt_tokens),
         "generated": len(req.generated),
         "slot": req.slot,
+        "tenant": getattr(req, "tenant", None),
+        "device_s": round(getattr(req, "device_s", 0.0), 6),
         "submitted_at": req.submitted_at,
         "admitted_at": req.admitted_at,
         "first_token_at": req.first_token_at,
@@ -149,12 +153,17 @@ def emit_engine_spans(tracer: Any, req: Any) -> None:
     trace_id, parent_id = trace
     end = req.finished_at or time.time()
     status = "OK" if req.error is None else f"ERROR: {req.error}"
+    attrs = {"prompt_tokens": len(req.prompt_tokens),
+             "generated_tokens": len(req.generated),
+             "slot": req.slot, "cancelled": req.cancelled}
+    if getattr(req, "tenant", None):
+        # the accounting identity: a trace found through an exemplar
+        # names who it was served for without a ledger lookup
+        attrs["tenant"] = req.tenant
     root = tracer.emit_span(
         "engine.request", trace_id=trace_id, parent_id=parent_id,
         start_time=req.submitted_at, end_time=end, status=status,
-        attributes={"prompt_tokens": len(req.prompt_tokens),
-                    "generated_tokens": len(req.generated),
-                    "slot": req.slot, "cancelled": req.cancelled})
+        attributes=attrs)
     admit = req.admitted_at or req.first_token_at or end
     tracer.emit_span("engine.queue", trace_id=trace_id,
                      parent_id=root.span_id, start_time=req.submitted_at,
@@ -174,6 +183,301 @@ def emit_engine_spans(tracer: Any, req: Any) -> None:
     tracer.emit_span("engine.retire", trace_id=trace_id,
                      parent_id=root.span_id, start_time=end, end_time=end,
                      attributes={"error": req.error or ""})
+
+
+# ----------------------------------------------------- usage metering
+def parse_window(spec: str | None) -> float | None:
+    """``"5m"``/``"1h"``/``"30s"``/``"300"`` -> seconds; None/'' -> None
+    (cumulative totals). Raises ValueError on garbage."""
+    if not spec:
+        return None
+    spec = spec.strip().lower()
+    mult = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}.get(spec[-1])
+    if mult is not None:
+        return float(spec[:-1]) * mult
+    return float(spec)
+
+
+def _fmt_window(seconds: float) -> str:
+    if seconds % 3600 == 0:
+        return f"{int(seconds // 3600)}h"
+    if seconds % 60 == 0:
+        return f"{int(seconds // 60)}m"
+    return f"{int(seconds)}s"
+
+
+class UsageLedger:
+    """Per-tenant usage accounting, fed once per retired request from
+    the engine's ``_finalize_obs`` — the metering plane behind
+    ``app_tenant_*`` metrics, ``GET /debug/usage`` and the federated
+    fleet rollup.
+
+    Everything is host arithmetic over numbers the engine already
+    collected (token counts, lifecycle timestamps, the per-pass
+    device-time shares accumulated during collects), recorded at
+    retire on the engine thread — the hot loop never touches this.
+    Cumulative totals live per tenant; a bounded event ring
+    (``window_records``) answers windowed queries, so
+    ``?window=5m`` rollups degrade gracefully (oldest events drop)
+    instead of growing without bound.
+    """
+
+    def __init__(self, metrics: Any = None,
+                 window_records: int = 4096) -> None:
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._totals: dict[str, dict] = {}
+        self._events: deque = deque(maxlen=max(1, int(window_records)))
+
+    @staticmethod
+    def _blank() -> dict:
+        return {"requests": {}, "prompt_tokens": 0,
+                "completion_tokens": 0, "device_s": 0.0,
+                "queue_s": 0.0, "e2e_s": 0.0}
+
+    def record(self, *, tenant: str, status: str, prompt_tokens: int,
+               completion_tokens: int, queue_s: float = 0.0,
+               e2e_s: float = 0.0, device_s: float = 0.0,
+               t: float | None = None) -> None:
+        t = time.time() if t is None else t
+        with self._lock:
+            tot = self._totals.setdefault(tenant, self._blank())
+            tot["requests"][status] = tot["requests"].get(status, 0) + 1
+            tot["prompt_tokens"] += int(prompt_tokens)
+            tot["completion_tokens"] += int(completion_tokens)
+            tot["device_s"] += float(device_s)
+            tot["queue_s"] += float(queue_s)
+            tot["e2e_s"] += float(e2e_s)
+            self._events.append(
+                {"t": t, "tenant": tenant, "status": status,
+                 "prompt_tokens": int(prompt_tokens),
+                 "completion_tokens": int(completion_tokens),
+                 "device_s": float(device_s), "queue_s": float(queue_s),
+                 "e2e_s": float(e2e_s)})
+        m = self.metrics
+        if m is None:
+            return
+        m.increment_counter("app_tenant_requests", tenant=tenant,
+                            status=status)
+        if prompt_tokens:
+            m.add_counter("app_tenant_prompt_tokens",
+                          float(prompt_tokens), tenant=tenant)
+        if completion_tokens:
+            m.add_counter("app_tenant_completion_tokens",
+                          float(completion_tokens), tenant=tenant)
+        if device_s > 0:
+            m.add_counter("app_tenant_device_seconds", float(device_s),
+                          tenant=tenant)
+        m.record_histogram("app_tenant_queue_seconds", float(queue_s),
+                           tenant=tenant)
+        m.record_histogram("app_tenant_e2e_seconds", float(e2e_s),
+                           tenant=tenant)
+
+    def rollup(self, tenant: str | None = None,
+               window_s: float | None = None) -> dict:
+        """The ``GET /debug/usage`` JSON: cumulative totals per tenant,
+        or windowed sums over the event ring when ``window_s`` is
+        given (flagged ``partial`` when the ring has rotated past the
+        window start — the caller knows the sum is a floor)."""
+        with self._lock:
+            if window_s is None:
+                per_tenant = {name: {**tot,
+                                     "requests": dict(tot["requests"])}
+                              for name, tot in self._totals.items()
+                              if tenant is None or name == tenant}
+                out = {"window": None, "tenants": per_tenant}
+            else:
+                cutoff = time.time() - window_s
+                per_tenant = {}
+                for ev in self._events:
+                    if ev["t"] < cutoff:
+                        continue
+                    if tenant is not None and ev["tenant"] != tenant:
+                        continue
+                    tot = per_tenant.setdefault(ev["tenant"],
+                                                self._blank())
+                    tot["requests"][ev["status"]] = \
+                        tot["requests"].get(ev["status"], 0) + 1
+                    for key in ("prompt_tokens", "completion_tokens",
+                                "device_s", "queue_s", "e2e_s"):
+                        tot[key] += ev[key]
+                partial = bool(self._events) and \
+                    self._events[0]["t"] > cutoff and \
+                    len(self._events) == self._events.maxlen
+                out = {"window": _fmt_window(window_s),
+                       "tenants": per_tenant, "partial": partial}
+        for tot in out["tenants"].values():
+            for key in ("device_s", "queue_s", "e2e_s"):
+                tot[key] = round(tot[key], 6)
+        return out
+
+
+# -------------------------------------------------------------- SLO
+@dataclass
+class SLOConfig:
+    """Service-level objectives for the chat path (docs/configs.md).
+
+    A retired request is GOOD when it finished without error and met
+    every configured latency threshold (``None`` disables that
+    dimension); cancelled requests are excluded (the client left —
+    nothing was violated). The tracker turns good/bad streams into
+    multi-window burn rates against the availability target, the
+    standard SRE alerting shape: burn rate 1.0 = spending the error
+    budget exactly at the sustainable pace.
+    """
+
+    #: time-to-first-token threshold (seconds); None = not judged
+    ttft_s: float | None = 2.0
+    #: mean inter-token latency threshold (seconds); None = not judged
+    tpot_s: float | None = 0.5
+    #: end-to-end latency threshold (seconds); None = not judged
+    e2e_s: float | None = 30.0
+    #: availability objective: the target fraction of good requests
+    availability: float = 0.999
+    #: burn-rate windows (seconds); the SHORTEST is the fast-burn
+    #: window the WARN escalation watches
+    windows: tuple = (300.0, 3600.0)
+    #: WARN once per episode when the fast-window burn rate crosses
+    #: this (14.4 = the classic "2% of a 30-day budget in one hour"
+    #: page threshold). 0 disables the escalation.
+    fast_burn: float = 14.4
+    #: horizon the error-budget-remaining gauge is computed over
+    budget_window_s: float = 86400.0
+    #: per-window event ring bound; beyond it the oldest events drop
+    #: (rates stay correct over what is retained)
+    max_events: int = 65536
+
+
+class SLOTracker:
+    """Multi-window burn-rate tracking over the retired-request
+    stream: ``app_slo_burn_rate{window=...}`` and
+    ``app_slo_error_budget_remaining`` gauges, the ``GET /debug/slo``
+    state, and a WARN once per fast-burn episode.
+
+    Fed from ``Engine._finalize_obs`` (host arithmetic at retire,
+    zero hot-path work). Each window keeps a rolling (deque, total,
+    bad) triple — O(1) amortized per request."""
+
+    def __init__(self, config: SLOConfig | None = None,
+                 metrics: Any = None, logger: Any = None) -> None:
+        self.config = config if config is not None else SLOConfig()
+        self.metrics = metrics
+        self.logger = logger
+        self._lock = threading.Lock()
+        horizons = tuple(sorted(set(
+            tuple(self.config.windows) + (self.config.budget_window_s,))))
+        self._wins = {w: {"events": deque(maxlen=self.config.max_events),
+                          "total": 0, "bad": 0} for w in horizons}
+        self._total = 0
+        self._bad = 0
+        self._escalated = False
+
+    # ------------------------------------------------------------ feed
+    def judge(self, *, error: str | None, ttft_s: float | None,
+              tpot_s: float | None, e2e_s: float | None) -> bool:
+        """Good iff no error and every configured threshold held."""
+        if error is not None:
+            return False
+        cfg = self.config
+        for value, limit in ((ttft_s, cfg.ttft_s),
+                             (tpot_s, cfg.tpot_s),
+                             (e2e_s, cfg.e2e_s)):
+            if limit is not None and value is not None and value > limit:
+                return False
+        return True
+
+    def record(self, good: bool, t: float | None = None) -> None:
+        t = time.time() if t is None else t
+        with self._lock:
+            self._total += 1
+            self._bad += 0 if good else 1
+            for w, win in self._wins.items():
+                if win["events"].maxlen == len(win["events"]):
+                    _, old_bad = win["events"][0]  # about to rotate out
+                    win["total"] -= 1
+                    win["bad"] -= old_bad
+                win["events"].append((t, 0 if good else 1))
+                win["total"] += 1
+                win["bad"] += 0 if good else 1
+                self._evict_locked(w, t)
+            state = self._state_locked(t)
+        self._publish(state)
+
+    def _evict_locked(self, w: float, now: float) -> None:
+        win = self._wins[w]
+        events = win["events"]
+        cutoff = now - w
+        while events and events[0][0] < cutoff:
+            _, bad = events.popleft()
+            win["total"] -= 1
+            win["bad"] -= bad
+
+    # ----------------------------------------------------------- state
+    def _burn_locked(self, w: float) -> dict:
+        win = self._wins[w]
+        total, bad = win["total"], win["bad"]
+        err_rate = (bad / total) if total else 0.0
+        budget = max(1e-9, 1.0 - self.config.availability)
+        return {"total": total, "bad": bad,
+                "error_rate": round(err_rate, 6),
+                "burn_rate": round(err_rate / budget, 4)}
+
+    def _state_locked(self, now: float) -> dict:
+        for w in self._wins:
+            self._evict_locked(w, now)
+        windows = {_fmt_window(w): self._burn_locked(w)
+                   for w in self.config.windows}
+        bw = self.config.budget_window_s
+        budget_win = self._burn_locked(bw)
+        allowed = budget_win["total"] * (1.0 - self.config.availability)
+        remaining = 1.0 - (budget_win["bad"] / allowed) if allowed > 0 \
+            else (0.0 if budget_win["bad"] else 1.0)
+        fast_w = min(self.config.windows)
+        fast = windows[_fmt_window(fast_w)]["burn_rate"]
+        return {
+            "objectives": {"ttft_s": self.config.ttft_s,
+                           "tpot_s": self.config.tpot_s,
+                           "e2e_s": self.config.e2e_s,
+                           "availability": self.config.availability},
+            "windows": windows,
+            "budget": {"window": _fmt_window(bw),
+                       "total": budget_win["total"],
+                       "bad": budget_win["bad"],
+                       "remaining": round(max(-1.0, min(1.0, remaining)),
+                                          6)},
+            "fast_burn": {"window": _fmt_window(fast_w),
+                          "burn_rate": fast,
+                          "threshold": self.config.fast_burn,
+                          "tripped": bool(self.config.fast_burn
+                                          and fast >= self.config.fast_burn)},
+            "lifetime": {"total": self._total, "bad": self._bad},
+        }
+
+    def state(self) -> dict:
+        """The ``GET /debug/slo`` payload."""
+        with self._lock:
+            return self._state_locked(time.time())
+
+    def _publish(self, state: dict) -> None:
+        m = self.metrics
+        if m is not None:
+            for label, win in state["windows"].items():
+                m.set_gauge("app_slo_burn_rate", win["burn_rate"],
+                            window=label)
+            m.set_gauge("app_slo_error_budget_remaining",
+                        state["budget"]["remaining"])
+        tripped = state["fast_burn"]["tripped"]
+        if tripped and not self._escalated:
+            self._escalated = True
+            if self.logger is not None:
+                self.logger.warn(
+                    "SLO fast burn: error budget burning at "
+                    f"{state['fast_burn']['burn_rate']}x over the "
+                    f"{state['fast_burn']['window']} window",
+                    threshold=state["fast_burn"]["threshold"],
+                    budget_remaining=state["budget"]["remaining"])
+        elif not tripped:
+            self._escalated = False  # episode over; re-arm
 
 
 # ----------------------------------------------------------- watchdog

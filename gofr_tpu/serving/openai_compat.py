@@ -145,13 +145,22 @@ class OpenAIRoutes:
                               "owned_by": "gofr-tpu"}]})
 
     # -------------------------------------------------------------- chat
+    @staticmethod
+    def _tenant_of(ctx) -> str | None:
+        """Auth principal -> accounting label (same resolver as the
+        native /chat path); usage metering works for OpenAI clients
+        authenticated with API keys / JWTs like any other route."""
+        resolver = getattr(ctx.container, "tenant_resolver", None)
+        return resolver.resolve(ctx.auth_info) if resolver else None
+
     async def chat_completions(self, ctx) -> Any:
         body = ctx.bind() or {}
         messages = body.get("messages")
         if not messages or not isinstance(messages, list):
             raise _OpenAIError("messages required", param="messages")
         prompt = self.render(messages)
-        return await self._complete(body, prompt, chat=True)
+        return await self._complete(body, prompt, chat=True,
+                                    tenant=self._tenant_of(ctx))
 
     async def completions(self, ctx) -> Any:
         body = ctx.bind() or {}
@@ -160,15 +169,16 @@ class OpenAIRoutes:
             prompt = prompt[0] if prompt else None
         if not prompt or not isinstance(prompt, str):
             raise _OpenAIError("prompt required", param="prompt")
-        return await self._complete(body, prompt, chat=False)
+        return await self._complete(body, prompt, chat=False,
+                                    tenant=self._tenant_of(ctx))
 
     # ------------------------------------------------------------ engine
     async def _complete(self, body: dict, prompt: str, *,
-                        chat: bool) -> Any:
+                        chat: bool, tenant: str | None = None) -> Any:
         params = _params_from(body)
         stops = _stops_from(body)
         prompt_tokens = self.tokenizer.encode(prompt)
-        req = self.engine.submit(prompt_tokens, params)
+        req = self.engine.submit(prompt_tokens, params, tenant=tenant)
         if req.error:
             raise _OpenAIError(req.error, status=503,
                                err_type="server_error")

@@ -1,0 +1,161 @@
+"""CI smoke: tenant metering + SLO + exemplars against a LIVE app.
+
+Boots a real App with API-key auth (two named tenants) and a tiny
+serving engine, drives authed chat requests from both tenants, then
+asserts the whole accounting plane end to end:
+
+- tenant-labeled ``app_tenant_*`` series on /metrics, with no raw key
+  anywhere in the exposition,
+- ``GET /debug/usage`` per-tenant token totals equal to the sum of the
+  chat responses' ``usage`` fields,
+- ``GET /debug/slo`` burn-rate state with a full error budget,
+- an OpenMetrics scrape (content-negotiated) carrying an exemplar that
+  resolves to a real ``engine.request`` trace id.
+
+Exits nonzero on any failure; one line per check on success.
+"""
+
+import asyncio
+import http.client
+import json
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from gofr_tpu.app import App
+from gofr_tpu.config import DictConfig
+from gofr_tpu.serving.engine import EngineConfig
+from gofr_tpu.serving.glue import demo_llama_engine
+from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+KEYS = {"alpha-key": "team-alpha", "beta-key": "team-beta"}
+
+
+def request(port: int, method: str, path: str, body=None, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    headers = dict(headers or {})
+    if isinstance(body, dict):
+        body = json.dumps(body)
+        headers.setdefault("Content-Type", "application/json")
+    try:
+        conn.request(method, path, body=body, headers=headers)
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def main() -> int:
+    engine = demo_llama_engine(EngineConfig(max_batch=4, max_seq=128,
+                                            seed=0))
+    app = App(config=DictConfig({
+        "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "APP_NAME": "slo-smoke", "TRACE_EXPORTER": "memory",
+        "GOFR_TELEMETRY": "false"}))
+    app.enable_api_key_auth(key_names=KEYS)
+    app.serve_model("llm", engine, ByteTokenizer())
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def runner():
+        asyncio.set_event_loop(loop)
+
+        async def main_coro():
+            await app.start()
+            started.set()
+            await app._stop_event.wait()
+
+        loop.run_until_complete(main_coro())
+
+    thread = threading.Thread(target=runner, daemon=True)
+    thread.start()
+    if not started.wait(60):
+        print("FAIL: app did not start", file=sys.stderr)
+        return 1
+    try:
+        port = app.http_server.bound_port
+        mport = app.metrics_server.bound_port
+        trace_id = "fe" * 16
+        usages = []
+        for i, (key, prompt) in enumerate((
+                ("alpha-key", "tenant smoke alpha one"),
+                ("alpha-key", "tenant smoke alpha two"),
+                ("beta-key", "tenant smoke beta"))):
+            headers = {"X-Api-Key": key}
+            if i == 0:
+                headers["traceparent"] = f"00-{trace_id}-{'cd' * 8}-01"
+            status, _, data = request(
+                port, "POST", "/chat",
+                {"prompt": prompt, "max_tokens": 6, "temperature": 0.0},
+                headers=headers)
+            assert status == 201, (status, data[:200])
+            usages.append(json.loads(data)["data"]["usage"])
+        assert [u["tenant"] for u in usages] == \
+            ["team-alpha", "team-alpha", "team-beta"]
+        status, _, _ = request(port, "POST", "/chat",
+                               {"prompt": "x", "max_tokens": 2})
+        assert status == 401, "unauthenticated chat must bounce"
+        print("ok: 3 authed /chat requests across 2 tenants (+401 bare)")
+
+        status, _, data = request(port, "GET", "/debug/usage",
+                                  headers={"X-Api-Key": "alpha-key"})
+        assert status == 200, status
+        tenants = json.loads(data)["data"]["llm"]["tenants"]
+        for label in ("team-alpha", "team-beta"):
+            want_p = sum(u["prompt_tokens"] for u in usages
+                         if u["tenant"] == label)
+            want_c = sum(u["completion_tokens"] for u in usages
+                         if u["tenant"] == label)
+            assert tenants[label]["prompt_tokens"] == want_p, label
+            assert tenants[label]["completion_tokens"] == want_c, label
+            assert tenants[label]["device_s"] > 0, label
+        print("ok: /debug/usage totals == sum of chat usage fields")
+
+        status, _, data = request(port, "GET", "/debug/slo",
+                                  headers={"X-Api-Key": "alpha-key"})
+        assert status == 200, status
+        slo = json.loads(data)["data"]["llm"]
+        assert slo["lifetime"]["total"] >= 3
+        assert slo["budget"]["remaining"] == 1.0, slo["budget"]
+        print("ok: /debug/slo tracking with full error budget")
+
+        status, _, data = request(mport, "GET", "/metrics")
+        assert status == 200, status
+        text = data.decode()
+        assert 'app_tenant_requests{status="ok",tenant="team-alpha"} 2' \
+            in text, "tenant-labeled request counter missing"
+        assert 'tenant="team-beta"' in text
+        assert "alpha-key" not in text and "beta-key" not in text, \
+            "raw API key leaked into the exposition"
+        assert "trace_id" not in text, "plain scrape must not carry exemplars"
+        print("ok: /metrics tenant series, no raw keys, plain format clean")
+
+        status, headers, data = request(
+            mport, "GET", "/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        assert status == 200, status
+        assert "application/openmetrics-text" in \
+            headers.get("Content-Type", ""), headers
+        om = data.decode()
+        assert om.rstrip().endswith("# EOF")
+        assert f'trace_id="{trace_id}"' in om, \
+            "traced request's exemplar missing from OpenMetrics scrape"
+        spans = app.container.tracer.exporter.spans
+        assert any(s.name == "engine.request" and s.trace_id == trace_id
+                   for s in spans), "exemplar trace id has no engine span"
+        print("ok: OpenMetrics exemplar resolves to an engine.request trace")
+    except AssertionError as exc:
+        print(f"FAIL: {exc}", file=sys.stderr)
+        return 1
+    finally:
+        asyncio.run_coroutine_threadsafe(app.stop(), loop).result(30)
+        thread.join(10)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

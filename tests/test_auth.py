@@ -75,17 +75,37 @@ class TestBasicAuth:
 
 class TestAPIKeyAuth:
     def test_static_keys(self):
+        from gofr_tpu.http.auth import credential_fingerprint
+
         def build(app):
             app.enable_api_key_auth("k1", "k2")
             app.get("/whoami", whoami)
         with AppRunner(build=build) as r:
             status, body = r.get_json("/whoami", headers={"X-Api-Key": "k2"})
-            assert status == 200 and body["data"]["api_key"] == "k2"
+            # the principal carries the key's fingerprint, never the
+            # raw credential — nothing downstream can leak it
+            assert status == 200
+            assert body["data"]["api_key"] == credential_fingerprint("k2")
+            assert "k2" not in json.dumps(body["data"])
             status, _, _ = r.request("GET", "/whoami",
                                      headers={"X-Api-Key": "bad"})
             assert status == 401
             status, _, _ = r.request("GET", "/whoami")
             assert status == 401
+
+    def test_key_names_map_to_tenant(self):
+        def build(app):
+            app.enable_api_key_auth("bare",
+                                    key_names={"named": "team-x"})
+            app.get("/whoami", whoami)
+        with AppRunner(build=build) as r:
+            status, body = r.get_json("/whoami",
+                                      headers={"X-Api-Key": "named"})
+            assert status == 200
+            assert body["data"]["tenant"] == "team-x"
+            status, body = r.get_json("/whoami",
+                                      headers={"X-Api-Key": "bare"})
+            assert status == 200 and "tenant" not in body["data"]
 
     def test_validator(self):
         def build(app):

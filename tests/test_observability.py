@@ -63,12 +63,49 @@ def test_every_serving_metric_write_is_registered():
 
     container = Container()
     container.register_framework_metrics()
+    # tenant metering + SLO series must live in the CONTAINER framework
+    # set (not only attach_metrics): federation merges them across
+    # hosts and leaders/aggregators never call attach_metrics
+    framework_missing = sorted(
+        n for n in written
+        if n.startswith(("app_tenant_", "app_slo_"))
+        and container.metrics.get(n) is None)
+    assert not framework_missing, (
+        f"tenant/SLO metric(s) written in serving/ but absent from the "
+        f"container framework set: {framework_missing}")
     eng = demo_llama_engine(EngineConfig(max_batch=2, max_seq=64))
     eng.attach_metrics(container.metrics)
     missing = sorted(n for n in written
                      if container.metrics.get(n) is None)
     assert not missing, (
         f"metric(s) written in serving/ but never registered: {missing}")
+
+
+def test_render_federated_merges_tenant_counters_across_hosts():
+    """The per-tenant counters ride the PR 4 federation path: identical
+    tenant labelsets SUM across hosts in merge_snapshots, and the
+    federated exposition carries each host's series under its host
+    label."""
+    from gofr_tpu.metrics.registry import merge_snapshots, render_federated
+    managers = {}
+    for host, tokens in (("host-a", 10), ("host-b", 32)):
+        m = MetricsManager()
+        m.new_counter("app_tenant_completion_tokens",
+                      "generated tokens by tenant")
+        m.add_counter("app_tenant_completion_tokens", float(tokens),
+                      tenant="acme")
+        managers[host] = m
+    snaps = {h: m.snapshot() for h, m in managers.items()}
+    merged = merge_snapshots(snaps)
+    fam = merged["metrics"]["app_tenant_completion_tokens"]
+    series = {tuple(sorted(s["labels"].items())): s["value"]
+              for s in fam["series"]}
+    assert series[(("tenant", "acme"),)] == 42.0  # summed, one labelset
+    text = render_federated(snaps)
+    assert 'app_tenant_completion_tokens{host="host-a",tenant="acme"} 10' \
+        in text
+    assert 'app_tenant_completion_tokens{host="host-b",tenant="acme"} 32' \
+        in text
 
 
 def test_attach_metrics_registers_on_bare_manager():

@@ -1,9 +1,25 @@
 """Tracer tests — span lifecycle, propagation, sampling, log correlation."""
 
+import pytest
+
 from gofr_tpu.logging import MockLogger
 from gofr_tpu.tracing import (
     InMemoryExporter, Tracer, extract_traceparent, format_traceparent,
 )
+
+
+@pytest.fixture(autouse=True)
+def _reset_trace_contextvars():
+    """The cross-thread test ends its span on ANOTHER thread, where the
+    contextvar token can't reset the main thread's context — without
+    this cleanup the span (and its trace ids) stay active on the main
+    thread and corrupt any log-asserting test that runs later in the
+    suite (the tier-1 runner executes files alphabetically)."""
+    yield
+    from gofr_tpu.logging.logger import _trace_ctx
+    from gofr_tpu.tracing.tracer import _current_span
+    _current_span.set(None)
+    _trace_ctx.set(None)
 
 
 def test_span_lifecycle_and_export():
