@@ -460,6 +460,73 @@ print("BENCH_JSON " + json.dumps({
 """
 
 
+# ------------------------------------------------------- perf ledger
+
+TRAJECTORY_FILE = "BENCH_TRAJECTORY.jsonl"
+
+
+def headline_metrics(payload: dict) -> dict:
+    """Flatten the per-scenario headline numbers out of a bench
+    payload — the stable metric set the perf ledger tracks run over
+    run and scripts/bench_compare.py gates on. Scenarios that errored
+    simply contribute nothing (their keys are absent, not zero)."""
+    out: dict = {}
+
+    def put(key, value):
+        if isinstance(value, (int, float)) and value >= 0:
+            out[key] = round(float(value), 3)
+
+    put("chat_req_per_s", payload.get("value"))
+    put("chat_tok_per_s", payload.get("tok_per_s"))
+    lat = payload.get("latency") or {}
+    for k in ("p50_ttft_ms", "p95_ttft_ms", "p50_tpot_ms",
+              "p95_tpot_ms"):
+        put(k, lat.get(k))
+    dec = payload.get("decode_overhead") or {}
+    put("decode_tok_per_s_fused", dec.get("tok_per_s_fused_m8"))
+    put("decode_tok_per_s_single", dec.get("tok_per_s_single"))
+    pf = payload.get("prefill_ttft") or {}
+    put("prefill_tok_per_s_kernel",
+        (pf.get("kernel") or {}).get("prefill_tok_per_s"))
+    put("prefill_tok_per_s_view",
+        (pf.get("view") or {}).get("prefill_tok_per_s"))
+    put("prefill_p50_ttft_ms", (pf.get("kernel") or {}).get("p50_ttft_ms"))
+    prod = payload.get("prod_shaped") or {}
+    put("prod_tok_per_s", prod.get("tok_per_s"))
+    put("prod_req_per_s", prod.get("req_per_s"))
+    return out
+
+
+def _append_trajectory(payload: dict) -> None:
+    """Append this run's headline numbers (plus provenance) to the
+    BENCH_TRAJECTORY.jsonl time series next to this file. The ledger
+    is append-only and best-effort: a write failure must never take
+    down the bench's stdout contract."""
+    try:
+        import platform as _platform
+        import time as _time
+        rec = {
+            "ts": round(_time.time(), 3),
+            "host": _platform.node(),
+            "status": payload.get("status") or
+                      ("cached" if payload.get("cached") else "unknown"),
+            "platform": payload.get("platform"),
+            "quantize": payload.get("quantize"),
+            "metrics": headline_metrics(payload),
+        }
+        if payload.get("error"):
+            rec["error"] = _trunc(payload["error"])
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            TRAJECTORY_FILE)
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(f"# trajectory: appended {rec['status']}/"
+              f"{rec['platform']} entry to {TRAJECTORY_FILE}",
+              file=sys.stderr)
+    except Exception as exc:  # pragma: no cover - ledger is advisory
+        print(f"# trajectory append failed: {exc!r}", file=sys.stderr)
+
+
 # --------------------------------------------------------------- parent
 
 def _probe(platform: str) -> bool:
@@ -581,6 +648,12 @@ def main() -> None:
                 cached["fresh_cpu"] = (fresh if fresh is not None
                                        else {"error": _trunc(fresh_err)})
                 print(json.dumps(cached))
+                _append_trajectory(cached)
+                if fresh is not None:
+                    # the fresh CPU sidecar is the number that tracks
+                    # THIS code — it joins the ledger in its own right
+                    fresh.setdefault("status", "fresh")
+                    _append_trajectory(fresh)
                 return
         plans.append(("cpu", CPU_BENCH_TIMEOUT_S))
 
@@ -605,9 +678,11 @@ def main() -> None:
                    "vs_baseline": 0.0, "status": "error",
                    "error": _trunc("; ".join(errors) or "unknown")}
         print(json.dumps(payload))
+        _append_trajectory(payload)
         sys.exit(1)
 
     print(json.dumps(payload))
+    _append_trajectory(payload)
 
 
 if __name__ == "__main__":

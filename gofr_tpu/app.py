@@ -349,9 +349,11 @@ class App:
     def _install_debug_routes(self) -> None:
         """Serving debug surface, registered once with the first
         ``serve_model``: ``GET /debug/engine`` (flight-recorder pass
-        ring + request logs + stats for every served model) and, when
+        ring + request logs + stats for every served model),
+        ``GET /debug/workload`` + ``POST /debug/workload/start|stop``
+        (workload capture download/arm/disarm) and, when
         ``PROFILER_ENABLED`` is set, ``POST /debug/profile/start|stop``
-        wrapping ``jax.profiler`` for on-demand xprof captures. Both
+        wrapping ``jax.profiler`` for on-demand xprof captures. All
         ride the normal middleware chain, so auth providers installed
         on the app guard them like any other route."""
         if getattr(self, "_debug_routes_installed", False):
@@ -359,11 +361,24 @@ class App:
         self._debug_routes_installed = True
         container = self.container
 
-        def engine_debug(ctx):
+        def bounded_int_param(ctx, name: str, default: int,
+                              lo: int, hi: int) -> int:
+            """Query-param hygiene for the debug surface: absent ->
+            default, out-of-range -> clamped into [lo, hi], anything
+            that is not an integer -> 400 (a typo'd ?n= must say so,
+            not silently dump a different amount of data)."""
+            raw = ctx.param(name)
+            if raw is None or raw == "":
+                return default
             try:
-                n = int(ctx.param("n") or 0)
+                value = int(raw)
             except (TypeError, ValueError):
-                n = 0
+                from .http.errors import ErrorInvalidParam
+                raise ErrorInvalidParam(name)
+            return max(lo, min(hi, value))
+
+        def engine_debug(ctx):
+            n = bounded_int_param(ctx, "n", default=0, lo=0, hi=65536)
             out = {}
             for model_name, engine in container.models.items():
                 recorder = getattr(engine, "recorder", None)
@@ -404,6 +419,56 @@ class App:
                 out[model_name] = slo.state() if slo is not None else None
             return out
         self.get("/debug/slo", slo_debug)
+
+        def pick_workload_recorder(ctx):
+            """``?model=`` selects among served models (404 on an
+            unknown name); default is the first served model — the
+            single-model case every deployment here actually runs."""
+            from .http.errors import ErrorEntityNotFound
+            name = ctx.param("model") or None
+            if not container.models:
+                raise ErrorEntityNotFound("model")
+            if name is None:
+                name = next(iter(container.models))
+            engine = container.models.get(name)
+            if engine is None:
+                raise ErrorEntityNotFound(f"model {name!r}")
+            recorder = getattr(engine, "workload", None)
+            if recorder is None:
+                raise ErrorEntityNotFound(
+                    f"model {name!r} has no workload recorder")
+            return name, recorder
+
+        def workload_download(ctx):
+            """The capture ring as a versioned JSONL workload file —
+            feed it to scripts/replay.py. ``?n=`` keeps only the last
+            n records (clamped; garbage -> 400)."""
+            from .http.response import File
+            n = bounded_int_param(ctx, "n", default=0, lo=0, hi=1 << 20)
+            _, recorder = pick_workload_recorder(ctx)
+            body = recorder.to_jsonl(n or None)
+            return File(content=body.encode(),
+                        content_type="application/jsonl; charset=utf-8")
+        self.get("/debug/workload", workload_download)
+
+        def workload_start(ctx):
+            """Arm capture (fresh ring). Body ``{"redact": true}``
+            switches the capture to salted-hash redaction."""
+            try:
+                body = ctx.bind() or {}
+            except Exception:
+                body = {}
+            redact = None
+            if isinstance(body, dict) and "redact" in body:
+                redact = bool(body.get("redact"))
+            name, recorder = pick_workload_recorder(ctx)
+            return {"model": name, "workload": recorder.start(redact)}
+        self.post("/debug/workload/start", workload_start)
+
+        def workload_stop(ctx):
+            name, recorder = pick_workload_recorder(ctx)
+            return {"model": name, "workload": recorder.stop()}
+        self.post("/debug/workload/stop", workload_stop)
 
         enabled = self.config.get_bool("PROFILER_ENABLED", False) \
             if hasattr(self.config, "get_bool") else False

@@ -46,6 +46,7 @@ path materialises a dense per-slot view of the pool.
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -58,6 +59,39 @@ _CompilerParams = getattr(pltpu, "CompilerParams", None) \
     or pltpu.TPUCompilerParams
 
 NEG_INF = -1e30
+
+#: Mosaic tiles the trailing two dims of every VMEM memref; the
+#: second-to-last ("sublane") dim is tiled in units of 8 rows, so any
+#: BlockSpec block or memref slice along it must cover a multiple of 8
+#: — BENCH_r05's real-TPU compile died on exactly this ("Slice shape
+#: along dimension 2 must be aligned to tiling (8), but is 1") when a
+#: grid cell's q block carried fewer than 8 rows (small GQA group).
+#: The q/out blocks below are zero-padded up to the tile and sliced
+#: back after the call; the pad rows compute finite garbage that never
+#: leaves the host wrapper.
+SUBLANE = 8
+
+
+def _pad_group(group: int, block_q: int = 1) -> int:
+    """Smallest padded GQA group size such that a q block of
+    ``block_q * group_padded`` rows is sublane-aligned (multiple of
+    8). ``block_q >= 8`` (always a power of two) needs no padding."""
+    step = SUBLANE // math.gcd(block_q, SUBLANE)
+    return -(-group // step) * step
+
+
+def _check_page_alignment(page: int, interpret: bool) -> None:
+    """The per-page DMA lands each page at row offset ``j * page`` of
+    the VMEM double buffer — a slice along the sublane dim, so the
+    page size must be tile-aligned on real hardware (interpret mode on
+    CPU has no tiling). The engine's default page_size=64 is fine;
+    this turns a cryptic Mosaic error into an actionable one."""
+    if not interpret and page % SUBLANE:
+        raise ValueError(
+            f"page size {page} is not a multiple of {SUBLANE}: the TPU "
+            f"kernel DMAs whole pages into sublane-tiled VMEM — use a "
+            f"page_size multiple of {SUBLANE} (or the 'xla'/'view' "
+            f"path)")
 
 
 def _is_tpu() -> bool:
@@ -165,12 +199,19 @@ def paged_decode_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
     _, max_pages = tables.shape
     group = hq // hkv
     scale = scale if scale is not None else hd ** -0.5
+    _check_page_alignment(page, interpret)
 
     # chunk ~128 rows per softmax fold, in whole pages
     pages_per_chunk = max(1, min(max_pages, -(-128 // page)))
     chunk = pages_per_chunk * page
 
+    # sublane alignment: each grid cell's q/out block is [group, hd]
+    # rows — pad the GQA group axis up to the 8-row tile (MHA group=1
+    # was BENCH_r05's Mosaic failure) and slice the pad back off below
+    group_p = _pad_group(group)
     q4 = q.reshape(b, hkv, group, hd)
+    if group_p != group:
+        q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, group_p - group), (0, 0)))
     kernel = functools.partial(
         _paged_decode_kernel, page=page, pages_per_chunk=pages_per_chunk,
         max_pages=max_pages, n_pages=n_pages, scale=scale)
@@ -178,27 +219,27 @@ def paged_decode_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
         num_scalar_prefetch=2,
         grid=(b, hkv),
         in_specs=[
-            pl.BlockSpec((1, 1, group, hd),
+            pl.BlockSpec((1, 1, group_p, hd),
                          lambda i, j, *_: (i, j, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),      # k pool stays in HBM
             pl.BlockSpec(memory_space=pl.ANY),      # v pool stays in HBM
         ],
-        out_specs=pl.BlockSpec((1, 1, group, hd),
+        out_specs=pl.BlockSpec((1, 1, group_p, hd),
                                lambda i, j, *_: (i, j, 0, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
             pltpu.VMEM((2, chunk, hd), k_pool.dtype),
             pltpu.VMEM((2, chunk, hd), v_pool.dtype),
-            pltpu.VMEM((group, hd), jnp.float32),
-            pltpu.VMEM((group, 1), jnp.float32),
-            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group_p, hd), jnp.float32),
+            pltpu.VMEM((group_p, 1), jnp.float32),
+            pltpu.VMEM((group_p, 1), jnp.float32),
             pltpu.SemaphoreType.DMA((2, 2, pages_per_chunk)),
         ],
     )
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b, hkv, group, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, group_p, hd), q.dtype),
         grid_spec=grid_spec,
         # grid cells (slot, kv-head) are independent: declaring them
         # parallel lets Mosaic software-pipeline across cells instead
@@ -208,6 +249,8 @@ def paged_decode_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
         interpret=interpret,
     )(tables.astype(jnp.int32), lengths.astype(jnp.int32),
       q4, k_pool, v_pool)
+    if group_p != group:
+        out = out[:, :, :group]
     return out.reshape(b, hq, hd)
 
 
@@ -363,23 +406,29 @@ def paged_chunk_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
         block_q = _pick_block_q(sq)
     if sq % block_q != 0:
         raise ValueError(f"block_q {block_q} must divide Sq {sq}")
+    _check_page_alignment(page, interpret)
 
     pages_per_chunk = max(1, min(max_pages, -(-128 // page)))
     chunk = pages_per_chunk * page
 
     # [B, Hkv, Sq*G, hd]: q rows flattened OUTSIDE the kernel so each
     # grid cell reads a plain 2D [BQ*G, hd] block — the q-block axis
-    # slices the (tiled) second-to-last dim in BQ*G-row steps, which
-    # stays tile-aligned for the serving shapes (BQ is a power of two;
-    # widths < 8 only occur in CPU interpret tests where Mosaic's
-    # tiling constraint doesn't apply)
-    q4 = q.reshape(b, sq, hkv, group, hd).transpose(0, 2, 1, 3, 4) \
-        .reshape(b, hkv, sq * group, hd)
+    # slices the (tiled) second-to-last dim in BQ*G-row steps. Those
+    # steps must be sublane-aligned (multiples of 8): narrow blocks
+    # (short chunks x small GQA group — e.g. a spec-verify window with
+    # block_q=1) pad the group axis up to the tile and slice the pad
+    # back off the output below.
+    group_p = _pad_group(group, block_q)
+    q5 = q.reshape(b, sq, hkv, group, hd)
+    if group_p != group:
+        q5 = jnp.pad(q5, ((0, 0), (0, 0), (0, 0),
+                          (0, group_p - group), (0, 0)))
+    q4 = q5.transpose(0, 2, 1, 3, 4).reshape(b, hkv, sq * group_p, hd)
     kernel = functools.partial(
         _paged_chunk_kernel, page=page, pages_per_chunk=pages_per_chunk,
         max_pages=max_pages, n_pages=n_pages, scale=scale,
-        block_q=block_q, group=group)
-    rows = block_q * group
+        block_q=block_q, group=group_p)
+    rows = block_q * group_p
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(b, hkv, sq // block_q),
@@ -404,7 +453,7 @@ def paged_chunk_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
     )
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b, hkv, sq * group, hd),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, sq * group_p, hd),
                                        q.dtype),
         grid_spec=grid_spec,
         compiler_params=_CompilerParams(
@@ -412,8 +461,9 @@ def paged_chunk_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
         interpret=interpret,
     )(tables.astype(jnp.int32), history_lens.astype(jnp.int32),
       chunk_lens.astype(jnp.int32), q4, k_pool, v_pool)
-    return out.reshape(b, hkv, sq, group, hd) \
-        .transpose(0, 2, 1, 3, 4).reshape(b, sq, hq, hd)
+    return out.reshape(b, hkv, sq, group_p, hd) \
+        .transpose(0, 2, 1, 3, 4)[:, :, :, :group] \
+        .reshape(b, sq, hq, hd)
 
 
 def paged_chunk_attention_xla(q: jnp.ndarray, k_pool: jnp.ndarray,

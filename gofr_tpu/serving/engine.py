@@ -265,6 +265,21 @@ class EngineConfig:
     flight_recorder_size: int = 256
     #: retired-request event logs kept alongside the pass ring
     flight_recorder_requests: int = 32
+    #: workload capture: arm the WorkloadRecorder at construction so
+    #: every retired request lands in the capture ring (arrival time,
+    #: prompt ids, gen params, seed, tenant, outcome) — the replayable
+    #: workload file behind ``GET /debug/workload``. Off by default;
+    #: ``POST /debug/workload/start`` arms it at runtime regardless.
+    #: Recording is retire-time host work — zero hot-path perturbation
+    #: (transfer-guard + greedy bit-identity hold with capture ON).
+    workload_capture: bool = False
+    #: capture ring bound: retired-request records kept (oldest drop,
+    #: counted). 0 disables the recorder entirely.
+    workload_capture_requests: int = 4096
+    #: redact captured workloads: prompt/completion token ids are
+    #: replaced by salted hashes (lengths kept) — shippable off-box,
+    #: not bit-identity-replayable (serving/observability.py)
+    capture_redact: bool = False
 
 
 class Engine:
@@ -295,9 +310,15 @@ class Engine:
         #: host timestamps); None = no spans. ``app.serve_model`` wires
         #: the container's tracer here.
         self.tracer = tracer
-        from .observability import FlightRecorder, UsageLedger
+        from .observability import (FlightRecorder, UsageLedger,
+                                    WorkloadRecorder)
         self.recorder = FlightRecorder(config.flight_recorder_size,
                                        config.flight_recorder_requests)
+        #: workload capture ring (armed lazily — see EngineConfig.
+        #: workload_capture); engine_seed is stamped below once the
+        #: sampling seed resolves
+        self.workload = WorkloadRecorder(config.workload_capture_requests,
+                                         redact=config.capture_redact)
         #: per-tenant usage metering, fed at retire (_finalize_obs);
         #: always present (host dicts only) — attach_metrics points it
         #: at the metrics manager so app_tenant_* series populate
@@ -351,6 +372,14 @@ class Engine:
         import os as _os
         seed = (cfg.seed if cfg.seed is not None
                 else int.from_bytes(_os.urandom(4), "little"))
+        #: the RESOLVED sampling seed (explicit or entropy-drawn) —
+        #: captured into workload records so a replay engine built with
+        #: EngineConfig(seed=header["engine_seed"]) reproduces the rng
+        #: stream; greedy replay is bit-identical either way (argmax)
+        self.seed = seed
+        self.workload.engine_seed = seed
+        if cfg.workload_capture:
+            self.workload.start()
         base_key = jax.random.key(seed % (2**31))
         # disjoint rng streams: prefill and decode fold into separate
         # subkeys so their per-step indices can never collide
@@ -804,6 +833,9 @@ class Engine:
             ("app_engine_stalls",
              "stall episodes escalated by the watchdog (work in "
              "flight, no pass for stall_threshold_s)"),
+            ("app_replay_divergence",
+             "replayed requests whose token stream diverged from the "
+             "recorded completion (serving/replay.py)"),
             ("app_tenant_requests",
              "retired requests by tenant and status (ok/error/"
              "cancelled)"),
@@ -1727,6 +1759,8 @@ class Engine:
         if self.recorder.enabled:
             from .observability import request_summary
             self.recorder.record_request(request_summary(req))
+        if self.workload.capturing:
+            self.workload.record(req)
         if self.tracer is not None and req.trace is not None:
             try:
                 from .observability import emit_engine_spans

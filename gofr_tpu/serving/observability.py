@@ -1,6 +1,6 @@
-"""Serving-path observability: flight recorder, engine trace assembly,
-tenant usage metering, SLO burn-rate tracking, on-demand profiler
-capture, MFU derivation.
+"""Serving-path observability: flight recorder, workload capture,
+engine trace assembly, tenant usage metering, SLO burn-rate tracking,
+on-demand profiler capture, MFU derivation.
 
 Everything in this module is HOST-side bookkeeping over timestamps and
 counters the engine already collects. The hard invariant is **zero
@@ -17,6 +17,7 @@ greedy bit-identity tests run with all of this enabled.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import threading
@@ -137,6 +138,161 @@ def request_summary(req: Any) -> dict:
         "events": [{"name": name, "t0": t0, "t1": t1, **(attrs or {})}
                    for name, t0, t1, attrs in req.events],
     }
+
+
+# ------------------------------------------------- workload capture
+#
+# Versioned workload-file format (JSONL): the first line is a header
+# object, every following line one retired request. The replay driver
+# (serving/replay.py) refuses unknown formats/versions, so the header
+# is the compatibility contract — bump WORKLOAD_VERSION on any
+# incompatible record change.
+WORKLOAD_FORMAT = "gofr-workload"
+WORKLOAD_VERSION = 1
+
+
+def salted_token_hash(tokens: Any, salt: str) -> str:
+    """Stable redaction digest of a token-id sequence. The salt is
+    drawn per recorder (never serialized), so captured hashes cannot
+    be dictionary-attacked against a known tokenizer — but two
+    requests with the same prompt in one capture still collide, which
+    is exactly what replay-divergence comparison needs."""
+    body = ",".join(str(int(t)) for t in tokens)
+    return hashlib.sha256(f"{salt}:{body}".encode()).hexdigest()[:24]
+
+
+class WorkloadRecorder:
+    """Bounded ring of per-request workload records — the capturable,
+    replayable twin of the :class:`FlightRecorder` (which keeps pass
+    telemetry; this keeps the *traffic*). Served as a versioned JSONL
+    file at ``GET /debug/workload``, armed/disarmed by
+    ``POST /debug/workload/start|stop`` or ``EngineConfig.workload_capture``.
+
+    Records are host-assembled ONCE per request at retire
+    (``Engine._finalize_obs``), from fields the engine already carries:
+    arrival timestamp, prompt token ids, sampling params, the engine's
+    resolved sampling seed, tenant label, and the outcome
+    (completion ids, TTFT/TPOT/e2e, finish reason). The hot loop never
+    touches this — the zero-perturbation invariant of the module holds
+    with capture ON (tested).
+
+    ``redact=True`` swaps prompt/completion token ids for salted
+    hashes (lengths preserved): safe to ship off-box, still good for
+    load-shape replay and hash-level divergence checks, but NOT for
+    bit-identity replay (the prompts are gone — ``replay_workload``
+    refuses).
+    """
+
+    def __init__(self, size: int = 4096, *, redact: bool = False,
+                 engine_seed: int | None = None) -> None:
+        self.enabled = size > 0
+        self.size = max(0, int(size))
+        self.redact = bool(redact)
+        self.engine_seed = engine_seed
+        self.capturing = False
+        self.started_at: float | None = None
+        self._salt = os.urandom(8).hex()
+        self._records: deque = deque(maxlen=max(1, self.size))
+        self._seq = 0
+        self._dropped = 0
+
+    # ------------------------------------------------------------ control
+    def start(self, redact: bool | None = None) -> dict:
+        """Arm capture with a FRESH ring (a capture is one workload —
+        stale records from an earlier session never bleed in)."""
+        if not self.enabled:
+            return self.status()
+        if redact is not None:
+            self.redact = bool(redact)
+        self._records.clear()
+        self._seq = 0
+        self._dropped = 0
+        self.started_at = time.time()
+        self.capturing = True
+        return self.status()
+
+    def stop(self) -> dict:
+        self.capturing = False
+        return self.status()
+
+    def status(self) -> dict:
+        return {"enabled": self.enabled, "capturing": self.capturing,
+                "redact": self.redact, "size": self.size,
+                "records": len(self._records), "recorded": self._seq,
+                "dropped": self._dropped, "started_at": self.started_at}
+
+    # ------------------------------------------------------------ writer
+    def record(self, req: Any) -> None:
+        """One retired request -> one record. Engine-thread append of a
+        plain dict onto a bounded deque — same writer discipline as the
+        flight recorder."""
+        if not (self.enabled and self.capturing):
+            return
+        self._seq += 1
+        if len(self._records) == self._records.maxlen:
+            self._dropped += 1
+        p = req.params
+        status = ("cancelled" if req.cancelled
+                  else "error" if req.error is not None else "ok")
+        end = req.finished_at
+        n = len(req.generated)
+        tpot_ms = None
+        if req.first_token_at is not None and end is not None and n > 1:
+            tpot_ms = (end - req.first_token_at) * 1000.0 / (n - 1)
+        rec: dict = {
+            "t": req.submitted_at,
+            "tenant": getattr(req, "tenant", None),
+            # per-request seed: today every request shares the engine's
+            # resolved sampling seed (rng keys ride the graphs as
+            # arguments, folded by a global step) — recorded per request
+            # so the format survives a future per-request rng
+            "seed": self.engine_seed,
+            "params": {"temperature": p.temperature, "top_p": p.top_p,
+                       "top_k": p.top_k,
+                       "max_new_tokens": p.max_new_tokens},
+            "status": status,
+        }
+        if self.redact:
+            rec["prompt_hash"] = salted_token_hash(req.prompt_tokens,
+                                                   self._salt)
+            rec["prompt_len"] = len(req.prompt_tokens)
+            rec["completion_hash"] = salted_token_hash(req.generated,
+                                                       self._salt)
+            rec["completion_len"] = n
+        else:
+            rec["prompt_tokens"] = list(req.prompt_tokens)
+            rec["completion_tokens"] = list(req.generated)
+        if req.error is not None:
+            rec["error"] = str(req.error)[:200]
+        if req.ttft_ms is not None:
+            rec["ttft_ms"] = round(req.ttft_ms, 3)
+        if tpot_ms is not None:
+            rec["tpot_ms"] = round(tpot_ms, 3)
+        if end is not None:
+            rec["e2e_ms"] = round((end - req.submitted_at) * 1000.0, 3)
+        self._records.append(rec)
+
+    # ------------------------------------------------------------ readers
+    def header(self) -> dict:
+        return {"format": WORKLOAD_FORMAT, "version": WORKLOAD_VERSION,
+                "redacted": self.redact, "engine_seed": self.engine_seed,
+                "started_at": self.started_at, "recorded": self._seq,
+                "dropped": self._dropped}
+
+    def snapshot(self, n: int | None = None) -> dict:
+        records = list(self._records)
+        if n is not None and n > 0:
+            records = records[-n:]
+        return {"header": self.header(), "records": records}
+
+    def to_jsonl(self, n: int | None = None) -> str:
+        """The ``GET /debug/workload`` body: header line, then one
+        line per record in arrival order (the ring holds retire order;
+        replay sorts by ``t`` anyway)."""
+        snap = self.snapshot(n)
+        lines = [json.dumps(snap["header"])]
+        lines.extend(json.dumps(rec) for rec in snap["records"])
+        return "\n".join(lines) + "\n"
 
 
 def emit_engine_spans(tracer: Any, req: Any) -> None:

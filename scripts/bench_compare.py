@@ -1,0 +1,205 @@
+"""Perf-regression gate over the bench trajectory ledger.
+
+``bench.py`` appends every run's headline numbers to
+``BENCH_TRAJECTORY.jsonl`` (one JSON object per line: ts, host,
+status fresh|cached|fallback|error, platform, metrics). This tool
+diffs the LATEST fresh entry for a platform against the PREVIOUS one
+and exits nonzero when any headline metric regressed by more than the
+threshold (default 10%) — throughput metrics regress by dropping,
+latency metrics (``*_ms``) by rising.
+
+Usage:
+    python scripts/bench_compare.py [--file PATH] [--platform cpu]
+                                    [--threshold 0.10] [--same-host]
+                                    [--self-test]
+
+Exit codes: 0 = no regression (or fewer than two comparable entries),
+1 = regression past the threshold, 2 = bad invocation/ledger.
+
+``--same-host`` restricts the comparison to entries from the same
+machine — the 10% default is meaningful within one host's series;
+cross-machine diffs (e.g. a CI runner vs the dev box that committed
+the previous entry) should pass a looser ``--threshold``.
+
+``--self-test`` runs the gate against synthetic entries (a clean pair,
+a 15% tokens/s drop, a 15% TTFT rise) and exits nonzero unless the
+detector catches exactly the regressions — the negative test CI runs
+before trusting the gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+DEFAULT_THRESHOLD = 0.10
+
+#: metrics where LOWER is a regression (throughput family)
+THROUGHPUT_KEYS = ("chat_req_per_s", "chat_tok_per_s",
+                   "decode_tok_per_s_fused", "decode_tok_per_s_single",
+                   "prefill_tok_per_s_kernel", "prefill_tok_per_s_view",
+                   "prod_tok_per_s", "prod_req_per_s")
+
+
+def is_latency(key: str) -> bool:
+    return key.endswith("_ms")
+
+
+def load_entries(path: str) -> list[dict]:
+    entries = []
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as exc:
+                raise ValueError(f"{path}:{i}: not JSON: {exc}") from exc
+            if isinstance(rec, dict):
+                entries.append(rec)
+    return entries
+
+
+def comparable(entries: list[dict], platform: str,
+               same_host: bool) -> list[dict]:
+    """Fresh entries for the platform, oldest -> newest. Cached and
+    fallback payloads are provenance-tainted (they may predate the
+    code under test) and never gate; error entries carry no metrics."""
+    fresh = [e for e in entries
+             if e.get("status") == "fresh"
+             and e.get("platform") == platform
+             and e.get("metrics")]
+    if same_host and fresh:
+        host = fresh[-1].get("host")
+        fresh = [e for e in fresh if e.get("host") == host]
+    return sorted(fresh, key=lambda e: e.get("ts", 0.0))
+
+
+def diff(prev: dict, cur: dict, threshold: float) -> tuple[list, list]:
+    """-> (regressions, lines). A regression is a relative change past
+    the threshold in the bad direction for a metric present in BOTH
+    entries; metrics only one side has are reported but never gate."""
+    pm, cm = prev.get("metrics", {}), cur.get("metrics", {})
+    regressions, lines = [], []
+    for key in sorted(set(pm) | set(cm)):
+        old, new = pm.get(key), cm.get(key)
+        if old is None or new is None:
+            lines.append(f"  {key:28s} {old} -> {new}  (uncomparable)")
+            continue
+        if old <= 0:
+            lines.append(f"  {key:28s} {old} -> {new}  (zero baseline)")
+            continue
+        change = (new - old) / old
+        bad = change < -threshold if key in THROUGHPUT_KEYS else \
+            change > threshold if is_latency(key) else False
+        marker = "  REGRESSION" if bad else ""
+        lines.append(f"  {key:28s} {old:>12} -> {new:>12}  "
+                     f"{change:+7.1%}{marker}")
+        if bad:
+            regressions.append({"metric": key, "prev": old, "cur": new,
+                                "change": round(change, 4)})
+    return regressions, lines
+
+
+def compare(entries: list[dict], *, platform: str, threshold: float,
+            same_host: bool) -> int:
+    series = comparable(entries, platform, same_host)
+    if len(series) < 2:
+        print(f"bench_compare: {len(series)} fresh '{platform}' "
+              f"entr{'y' if len(series) == 1 else 'ies'} in the ledger "
+              f"— nothing to diff yet (gate passes vacuously)")
+        return 0
+    prev, cur = series[-2], series[-1]
+    print(f"bench_compare: {platform} fresh "
+          f"ts={prev.get('ts')} ({prev.get('host')}) -> "
+          f"ts={cur.get('ts')} ({cur.get('host')}), "
+          f"threshold {threshold:.0%}")
+    regressions, lines = diff(prev, cur, threshold)
+    print("\n".join(lines))
+    if regressions:
+        print(f"bench_compare: {len(regressions)} headline metric(s) "
+              f"regressed past {threshold:.0%}: "
+              + ", ".join(r["metric"] for r in regressions))
+        return 1
+    print("bench_compare: no regression past the threshold")
+    return 0
+
+
+# ------------------------------------------------------------ self-test
+def self_test() -> int:
+    """The gate must catch a synthetic >10% tokens/s regression and a
+    latency rise, and must pass identical entries — run by CI before
+    the real comparison so a broken detector cannot silently wave
+    regressions through."""
+    base = {"status": "fresh", "platform": "cpu", "host": "h", "ts": 1.0,
+            "metrics": {"chat_tok_per_s": 1000.0, "chat_req_per_s": 50.0,
+                        "p50_ttft_ms": 40.0}}
+
+    def entry(ts, **overrides):
+        rec = json.loads(json.dumps(base))
+        rec["ts"] = ts
+        rec["metrics"].update(overrides)
+        return rec
+
+    checks = [
+        ("identical entries pass",
+         [base, entry(2.0)], 0),
+        ("5% tokens/s dip within threshold passes",
+         [base, entry(2.0, chat_tok_per_s=950.0)], 0),
+        ("15% tokens/s regression fails",
+         [base, entry(2.0, chat_tok_per_s=850.0)], 1),
+        ("15% TTFT rise fails",
+         [base, entry(2.0, p50_ttft_ms=46.0)], 1),
+        ("15% tokens/s IMPROVEMENT passes",
+         [base, entry(2.0, chat_tok_per_s=1150.0)], 0),
+        ("single entry passes vacuously",
+         [base], 0),
+        ("cached entries never gate",
+         [base, dict(entry(2.0, chat_tok_per_s=1.0),
+                     status="cached")], 0),
+    ]
+    failed = 0
+    for name, entries, want in checks:
+        got = compare(entries, platform="cpu",
+                      threshold=DEFAULT_THRESHOLD, same_host=False)
+        ok = got == want
+        print(f"self-test {'ok' if ok else 'FAIL'}: {name} "
+              f"(exit {got}, want {want})")
+        failed += 0 if ok else 1
+    return 1 if failed else 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    default_file = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_TRAJECTORY.jsonl")
+    ap.add_argument("--file", default=default_file)
+    ap.add_argument("--platform", default="cpu")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD)
+    ap.add_argument("--same-host", action="store_true")
+    ap.add_argument("--self-test", action="store_true")
+    args = ap.parse_args()
+    if args.self_test:
+        return self_test()
+    if args.threshold <= 0:
+        print("bench_compare: threshold must be > 0", file=sys.stderr)
+        return 2
+    if not os.path.exists(args.file):
+        print(f"bench_compare: no ledger at {args.file} — run bench.py "
+              f"first (gate passes vacuously)")
+        return 0
+    try:
+        entries = load_entries(args.file)
+    except ValueError as exc:
+        print(f"bench_compare: {exc}", file=sys.stderr)
+        return 2
+    return compare(entries, platform=args.platform,
+                   threshold=args.threshold, same_host=args.same_host)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

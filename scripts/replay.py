@@ -1,0 +1,86 @@
+"""Replay a captured workload file through a local engine.
+
+Usage:
+    python scripts/replay.py WORKLOAD.jsonl [--speed N] [--closed-loop C]
+                             [--seed S] [--max-batch B] [--max-seq L]
+                             [--report OUT.json] [--no-fail]
+
+Downloads from a live server land here:
+    curl -s http://host:8000/debug/workload > incident.jsonl
+    python scripts/replay.py incident.jsonl
+
+Builds the demo tiny-llama engine (the same model family the CPU
+smokes and tests use) with the workload header's ``engine_seed``
+unless ``--seed`` overrides it, re-injects the workload with original
+inter-arrival timing (``--speed N`` compresses it, ``--closed-loop C``
+ignores timing and keeps C in flight), and prints the divergence +
+latency report JSON. Greedy requests must replay bit-identically when
+the engine matches the capture (same model weights/config/seed);
+exits 2 on any divergence unless ``--no-fail``.
+
+For a production model, call ``gofr_tpu.serving.replay.replay_file``
+against your own engine instead — the driver is model-agnostic.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("workload", help="workload JSONL file "
+                    "(GET /debug/workload)")
+    ap.add_argument("--speed", type=float, default=1.0,
+                    help="inter-arrival compression factor (default 1)")
+    ap.add_argument("--closed-loop", type=int, default=0, metavar="C",
+                    help="ignore timing; keep C requests in flight")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="override the header's engine_seed")
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--max-seq", type=int, default=256)
+    ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--report", default=None,
+                    help="also write the report JSON to this path")
+    ap.add_argument("--no-fail", action="store_true",
+                    help="exit 0 even when streams diverged")
+    args = ap.parse_args()
+
+    from gofr_tpu.serving.engine import EngineConfig
+    from gofr_tpu.serving.glue import demo_llama_engine
+    from gofr_tpu.serving.replay import load_workload, replay_workload
+
+    workload = load_workload(args.workload)
+    header = workload["header"]
+    seed = args.seed if args.seed is not None \
+        else header.get("engine_seed")
+    print(f"# workload: {len(workload['records'])} records, "
+          f"engine_seed={header.get('engine_seed')}, "
+          f"redacted={header.get('redacted')}", file=sys.stderr)
+    engine = demo_llama_engine(EngineConfig(
+        max_batch=args.max_batch, max_seq=args.max_seq,
+        seed=seed if seed is not None else 0))
+    try:
+        report = replay_workload(engine, workload, speed=args.speed,
+                                 closed_loop=args.closed_loop,
+                                 timeout_s=args.timeout)
+    finally:
+        engine.stop()
+    text = json.dumps(report, indent=2, default=str)
+    print(text)
+    if args.report:
+        with open(args.report, "w") as f:
+            f.write(text + "\n")
+    if report["divergent"] and not args.no_fail:
+        print(f"# DIVERGED: {report['divergent']} request(s)",
+              file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,182 @@
+"""CI smoke: workload capture -> deterministic replay against a LIVE app.
+
+Boots a real App with API-key auth (two named tenants) and a tiny
+serving engine, then proves the whole capture/replay plane end to end:
+
+- ``POST /debug/workload/start`` arms capture; six authed /chat
+  requests across two tenants run greedy; ``POST /debug/workload/stop``
+  disarms; ``GET /debug/workload`` downloads the versioned JSONL file,
+- the endpoints harden bad input (garbage ``?n=`` -> 400, negative/huge
+  -> clamp) and respect the app's auth (bare requests -> 401),
+- a FRESH engine built with the same config + the header's
+  ``engine_seed`` replays the file: greedy replay must be
+  **bit-identical** (zero divergence) and the report must carry both
+  recorded and replayed latency,
+- a deliberately tampered record must be caught and located.
+
+Exits nonzero on any failure; one line per check on success.
+"""
+
+import asyncio
+import http.client
+import json
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from gofr_tpu.app import App
+from gofr_tpu.config import DictConfig
+from gofr_tpu.serving.engine import EngineConfig
+from gofr_tpu.serving.glue import demo_llama_engine
+from gofr_tpu.serving.replay import parse_workload, replay_workload
+from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+KEYS = {"alpha-key": "team-alpha", "beta-key": "team-beta"}
+SEED = 41
+ENGINE_CFG = dict(max_batch=4, max_seq=128, seed=SEED)
+
+
+def request(port: int, method: str, path: str, body=None, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    headers = dict(headers or {})
+    if isinstance(body, dict):
+        body = json.dumps(body)
+        headers.setdefault("Content-Type", "application/json")
+    try:
+        conn.request(method, path, body=body, headers=headers)
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def main() -> int:
+    engine = demo_llama_engine(EngineConfig(**ENGINE_CFG))
+    app = App(config=DictConfig({
+        "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "APP_NAME": "replay-smoke", "GOFR_TELEMETRY": "false"}))
+    app.enable_api_key_auth(key_names=KEYS)
+    app.serve_model("llm", engine, ByteTokenizer())
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def runner():
+        asyncio.set_event_loop(loop)
+
+        async def main_coro():
+            await app.start()
+            started.set()
+            await app._stop_event.wait()
+
+        loop.run_until_complete(main_coro())
+
+    thread = threading.Thread(target=runner, daemon=True)
+    thread.start()
+    if not started.wait(60):
+        print("FAIL: app did not start", file=sys.stderr)
+        return 1
+    auth = {"X-Api-Key": "alpha-key"}
+    try:
+        port = app.http_server.bound_port
+
+        # -------------------------------------------------- hardening
+        for path in ("/debug/workload?n=zzz", "/debug/engine?n=zzz"):
+            status, _, _ = request(port, "GET", path, headers=auth)
+            assert status == 400, (path, status)
+        for path in ("/debug/workload?n=-3",
+                     "/debug/engine?n=99999999999"):
+            status, _, _ = request(port, "GET", path, headers=auth)
+            assert status == 200, (path, status)
+        status, _, _ = request(port, "GET", "/debug/workload")
+        assert status == 401, "unauthenticated workload read must bounce"
+        print("ok: /debug/workload|engine clamp bad n, 400 garbage, "
+              "401 bare")
+
+        # ---------------------------------------------------- capture
+        status, _, _ = request(port, "POST", "/debug/workload/start",
+                               headers=auth)
+        assert status in (200, 201), status
+        sent = []
+        for i, (key, prompt) in enumerate((
+                ("alpha-key", "replay smoke alpha one"),
+                ("alpha-key", "replay smoke alpha two"),
+                ("beta-key", "replay smoke beta one"),
+                ("alpha-key", "replay smoke alpha three"),
+                ("beta-key", "replay smoke beta two"),
+                ("beta-key", "replay smoke beta three"))):
+            status, _, data = request(
+                port, "POST", "/chat",
+                {"prompt": prompt, "max_tokens": 6, "temperature": 0.0},
+                headers={"X-Api-Key": key})
+            assert status == 201, (status, data[:200])
+            sent.append(json.loads(data)["data"])
+        status, _, data = request(port, "POST", "/debug/workload/stop",
+                                  headers=auth)
+        assert status in (200, 201), status
+        assert json.loads(data)["data"]["workload"]["records"] == 6
+        print("ok: captured 6 greedy /chat requests across 2 tenants")
+
+        status, headers, data = request(port, "GET", "/debug/workload",
+                                        headers=auth)
+        assert status == 200, status
+        assert "application/jsonl" in headers.get("Content-Type", "")
+        workload = parse_workload(data.decode())
+        assert workload["header"]["engine_seed"] == SEED
+        assert len(workload["records"]) == 6
+        tenants = {r["tenant"] for r in workload["records"]}
+        assert tenants == {"team-alpha", "team-beta"}, tenants
+        recorded_tokens = sorted(
+            tuple(r["completion_tokens"]) for r in workload["records"])
+        chat_tokens = sorted(tuple(u["tokens"]) for u in sent)
+        assert recorded_tokens == chat_tokens, \
+            "captured completions != tokens the chat responses returned"
+        print("ok: /debug/workload JSONL carries the exact served "
+              "completions")
+
+        # ----------------------------------------------------- replay
+        fresh = demo_llama_engine(EngineConfig(
+            max_batch=ENGINE_CFG["max_batch"],
+            max_seq=ENGINE_CFG["max_seq"],
+            seed=workload["header"]["engine_seed"]))
+        try:
+            report = replay_workload(fresh, workload, speed=100.0,
+                                     timeout_s=120.0)
+        finally:
+            fresh.stop()
+        assert report["compared"] == 6, report
+        assert report["divergent"] == 0, report["divergences"]
+        assert report["bit_identical"] is True
+        assert report["recorded_latency"]["p50_ttft_ms"] is not None
+        assert report["replayed_latency"]["p50_ttft_ms"] is not None
+        print("ok: greedy replay through a fresh engine is "
+              "bit-identical (0/6 divergent)")
+
+        # a tampered completion must be caught and located
+        tampered = json.loads(json.dumps(workload))
+        tampered["records"][2]["completion_tokens"][1] ^= 1
+        fresh2 = demo_llama_engine(EngineConfig(
+            max_batch=ENGINE_CFG["max_batch"],
+            max_seq=ENGINE_CFG["max_seq"], seed=SEED))
+        try:
+            report2 = replay_workload(fresh2, tampered, speed=100.0,
+                                      timeout_s=120.0)
+        finally:
+            fresh2.stop()
+        assert report2["divergent"] == 1, report2
+        assert report2["divergences"][0]["first_divergent_token"] == 1
+        print("ok: tampered record detected at first divergent token")
+    except AssertionError as exc:
+        print(f"FAIL: {exc}", file=sys.stderr)
+        return 1
+    finally:
+        asyncio.run_coroutine_threadsafe(app.stop(), loop).result(30)
+        thread.join(10)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -107,6 +107,104 @@ def test_zero_length_slot_returns_zeros_not_nan():
     np.testing.assert_allclose(np.asarray(got[0]), 0.0, atol=1e-6)
 
 
+# --------------------------------------------- Mosaic sublane alignment
+#
+# BENCH_r05's first real-TPU compile died in Mosaic: "Slice shape
+# along dimension 2 must be aligned to tiling (8), but is 1" — a grid
+# cell's q/out block carried fewer than 8 rows along the sublane dim
+# (small GQA group x short q block). The wrappers now pad those blocks
+# to the 8-row tile; these tests pin (a) the alignment arithmetic for
+# every group/block_q the serving shapes can produce and (b) interpret
+# -mode parity on the exact shapes that used to emit misaligned slices,
+# so the regression is caught on CPU, not in the next TPU window.
+
+def test_sublane_padding_always_tile_aligned():
+    from gofr_tpu.ops.paged_attention import SUBLANE, _pad_group
+    for group in range(1, 33):
+        padded = _pad_group(group)
+        assert padded >= group and padded % SUBLANE == 0, (group, padded)
+        for block_q in (1, 2, 4, 8, 16, 32, 64, 128):
+            rows = block_q * _pad_group(group, block_q)
+            assert rows % SUBLANE == 0, (group, block_q, rows)
+            assert _pad_group(group, block_q) >= group
+    # no waste where none is needed: already-aligned shapes unchanged
+    assert _pad_group(8) == 8
+    assert _pad_group(4, 2) == 4
+    assert _pad_group(1, 8) == 1
+
+
+@pytest.mark.parametrize("hq,hkv", [(4, 4),    # MHA: group=1, the
+                                               # "but is 1" failure
+                                    (8, 2),    # group=4 (llama3-1b)
+                                    (6, 2)])   # group=3: odd group
+def test_decode_parity_with_sub_tile_group(hq, hkv):
+    """Small-GQA-group decode blocks (sublane-padded) still match the
+    dense reference bit-for-bit in interpret mode."""
+    case = _random_paged_case(jax.random.key(7), hq=hq, hkv=hkv,
+                              lengths=(5, 17, 48))
+    q, k_pool, v_pool, tables, lengths, k_dense, v_dense = case
+    want = decode_attention(q[:, None], k_dense, v_dense, lengths)[:, 0]
+    got = paged_decode_attention_pallas(q, k_pool, v_pool, tables,
+                                        lengths, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_chunk_parity_with_sub_tile_rows():
+    """Chunk blocks whose block_q x group < 8 (the spec-verify window
+    shape: tiny Sq, small group) pad to the tile and stay correct."""
+    from gofr_tpu.ops.attention import xla_attention
+    from gofr_tpu.ops.paged_attention import paged_chunk_attention_pallas
+    b, sq, hq, hkv, hd = 2, 5, 4, 4, 16     # group=1, block_q=1 -> 1 row
+    page, max_pages, n_pages = 8, 6, 32
+    ks = jax.random.split(jax.random.key(8), 3)
+    q = jax.random.normal(ks[0], (b, sq, hq, hd), jnp.float32)
+    k_pool = jax.random.normal(ks[1], (hkv, n_pages, page, hd),
+                               jnp.float32)
+    v_pool = jax.random.normal(ks[2], (hkv, n_pages, page, hd),
+                               jnp.float32)
+    rng = np.random.default_rng(3)
+    history = np.asarray([11, 0], np.int32)
+    chunk_lens = np.asarray([sq, 3], np.int32)
+    tables = np.full((b, max_pages), n_pages, np.int32)
+    for i in range(b):
+        need = -(-int(history[i] + chunk_lens[i]) // page)
+        tables[i, :need] = rng.choice(n_pages, size=need, replace=False)
+    tables = jnp.asarray(tables)
+    got = paged_chunk_attention_pallas(
+        q, k_pool, v_pool, tables, jnp.asarray(history),
+        jnp.asarray(chunk_lens), interpret=True)
+    safe = jnp.minimum(tables, n_pages - 1)
+    k_dense = k_pool[:, safe].transpose(1, 2, 3, 0, 4).reshape(
+        b, max_pages * page, hkv, hd)
+    v_dense = v_pool[:, safe].transpose(1, 2, 3, 0, 4).reshape(
+        b, max_pages * page, hkv, hd)
+    want = xla_attention(q, k_dense, v_dense, causal=True,
+                         q_offset=jnp.asarray(history),
+                         kv_lengths=jnp.asarray(history)
+                         + jnp.asarray(chunk_lens))
+    for i in range(b):
+        n = int(chunk_lens[i])  # rows past chunk_len are padding
+        np.testing.assert_allclose(np.asarray(got)[i, :n],
+                                   np.asarray(want)[i, :n],
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_page_misalignment_raises_actionable_error():
+    """A page size that cannot DMA into sublane-tiled VMEM must fail
+    with a message naming the fix, not a Mosaic internal error (only
+    on the compiled path — interpret mode has no tiling)."""
+    case = _random_paged_case(jax.random.key(9), page=4, max_pages=12,
+                              lengths=(5, 9, 3))
+    q, k_pool, v_pool, tables, lengths, *_ = case
+    with pytest.raises(ValueError, match="multiple of 8"):
+        paged_decode_attention_pallas(q, k_pool, v_pool, tables,
+                                      lengths, interpret=False)
+    # interpret mode still accepts it (CPU tests use small pages)
+    paged_decode_attention_pallas(q, k_pool, v_pool, tables, lengths,
+                                  interpret=True)
+
+
 # ------------------------------------------------- engine-level parity
 
 def test_paged_native_engine_matches_slot_engine():
