@@ -377,6 +377,21 @@ class App:
                 raise ErrorInvalidParam(name)
             return max(lo, min(hi, value))
 
+        def trace_export_state(ctx=None):
+            """Span-exporter backpressure state: a bounded exporter
+            (InMemoryExporter ring) that evicted spans must say so —
+            a silently truncated trace capture reads as 'no spans
+            there', which is a lie."""
+            exporter = getattr(container.tracer, "exporter", None)
+            if exporter is None or not hasattr(exporter, "dropped"):
+                return None
+            out = {"dropped_spans": int(exporter.dropped)}
+            spans = getattr(exporter, "spans", None)
+            if spans is not None:
+                out["buffered_spans"] = len(spans)
+                out["max_spans"] = getattr(exporter, "max_spans", None)
+            return out
+
         def engine_debug(ctx):
             n = bounded_int_param(ctx, "n", default=0, lo=0, hi=65536)
             out = {}
@@ -389,8 +404,26 @@ class App:
                     "flight": recorder.snapshot(n or None)
                     if recorder is not None else None,
                 }
+            traces = trace_export_state()
+            if traces is not None:
+                out["traces"] = traces
             return out
         self.get("/debug/engine", engine_debug)
+
+        def efficiency_debug(ctx):
+            """Goodput rollup per served model: where the busy
+            device-seconds went (useful vs. waste by cause, conserved),
+            memory high-water marks with timestamps, and the recompile
+            sentinel's state — the first stop of the 'where did my
+            FLOPs go' runbook (docs/operations.md)."""
+            out = {}
+            for model_name, engine in container.models.items():
+                if hasattr(engine, "efficiency_state"):
+                    out[model_name] = engine.efficiency_state()
+                else:
+                    out[model_name] = None
+            return out
+        self.get("/debug/efficiency", efficiency_debug)
 
         def usage_debug(ctx):
             """Per-tenant usage rollup: ``?tenant=`` filters,
@@ -527,6 +560,21 @@ class App:
                 self.container.metrics.set_gauge(
                     "app_uptime_seconds",
                     round(time.time() - self.container._start_time, 1))
+                # bounded-exporter backpressure: spans the ring evicted
+                # (InMemoryExporter.dropped) — refreshed at scrape so a
+                # truncated trace capture is visible, never silent
+                exporter = getattr(self.container.tracer, "exporter",
+                                   None)
+                dropped = getattr(exporter, "dropped", None)
+                if dropped is not None:
+                    m = self.container.metrics
+                    if m.get("app_traces_dropped_spans") is None:
+                        m.new_gauge(
+                            "app_traces_dropped_spans",
+                            "spans evicted by the bounded in-memory "
+                            "exporter ring (backpressure drops)")
+                    m.set_gauge("app_traces_dropped_spans",
+                                float(dropped))
                 # content negotiation: a scraper asking for OpenMetrics
                 # (Prometheus does when exemplar storage is on) gets
                 # the exemplar-bearing exposition; everyone else gets

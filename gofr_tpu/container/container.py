@@ -244,6 +244,10 @@ class Container:
         m.new_gauge("app_fleet_straggler_ratio",
                     "fraction of hosts whose p95 pass duration exceeds "
                     "straggler_ratio x the fleet median")
+        m.new_gauge("app_fleet_goodput_ratio",
+                    "fleet-wide useful device time over busy device "
+                    "time, summed across member heartbeat goodput "
+                    "digests")
         m.new_counter("app_fleet_evictions",
                       "hosts evicted from the serving group "
                       "(by reason label)")
@@ -262,6 +266,9 @@ class Container:
         m.new_counter("app_tenant_device_seconds",
                       "device busy time attributed to each tenant "
                       "(per-request share of every pass's busy span)")
+        m.new_counter("app_tenant_waste_seconds",
+                      "per-tenant attributable waste device time by "
+                      "cause (preempt_recompute, spec_rejected)")
         m.new_histogram("app_tenant_queue_seconds",
                         "admission queue wait by tenant",
                         buckets=latency_buckets)

@@ -72,7 +72,7 @@ import statistics
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable
+from typing import Any, Callable, Mapping
 
 from ..http.errors import ErrorInvalidParam, HTTPError
 from ..logging.logger import set_fleet_context
@@ -189,6 +189,9 @@ _FLEET_GAUGES = (
     ("app_fleet_straggler_ratio",
      "fraction of hosts whose p95 pass duration exceeds "
      "straggler_ratio x the fleet median"),
+    ("app_fleet_goodput_ratio",
+     "fleet-wide useful device time over busy device time, summed "
+     "across member heartbeat goodput digests"),
 )
 _FLEET_COUNTERS = (
     ("app_fleet_evictions",
@@ -374,6 +377,15 @@ class ControlPlaneLeader:
         worst = max(values, key=values.get)
         return values[worst] / med, worst
 
+    @staticmethod
+    def _dominant_waste(waste: Mapping | None) -> str | None:
+        """Largest waste cause from a heartbeat summary's ``waste_s``
+        map — the leader's one-word answer to WHY a host is slow."""
+        if not isinstance(waste, Mapping) or not waste:
+            return None
+        cause = max(waste, key=lambda c: float(waste.get(c) or 0.0))
+        return cause if float(waste.get(cause) or 0.0) > 0 else None
+
     def _recompute_skew(self) -> dict:
         """Leader-side straggler math over the latest heartbeat
         summaries: pure host arithmetic, called at heartbeat cadence.
@@ -387,6 +399,17 @@ class ControlPlaneLeader:
                     for h, m in self._members.items()
                     if isinstance(m.summary.get("occupancy_mean"),
                                   (int, float))}
+            # goodput federation: heartbeat summaries carry each
+            # host's busy/useful/waste digest (FlightRecorder.
+            # fleet_summary via the engine's GoodputMeter)
+            goodputs = {h: {"busy_s": float(m.summary["busy_s"]),
+                            "useful_s": float(
+                                m.summary.get("useful_s", 0.0)),
+                            "waste_s": dict(m.summary.get("waste_s")
+                                            or {})}
+                        for h, m in self._members.items()
+                        if isinstance(m.summary.get("busy_s"),
+                                      (int, float))}
             world = len(self._members)
         pass_skew, worst = self._skew(p95s)
         occ_skew, _ = self._skew(occs)
@@ -397,6 +420,23 @@ class ControlPlaneLeader:
         new = set(stragglers) - self._stragglers
         self._stragglers = set(stragglers)
         ratio = len(stragglers) / world if world else 0.0
+        fleet_goodput: dict = {}
+        if goodputs:
+            busy = sum(g["busy_s"] for g in goodputs.values())
+            useful = sum(g["useful_s"] for g in goodputs.values())
+            waste: dict[str, float] = {}
+            for g in goodputs.values():
+                for cause, v in g["waste_s"].items():
+                    waste[cause] = waste.get(cause, 0.0) + float(v or 0)
+            fleet_goodput = {
+                "busy_s": round(busy, 6), "useful_s": round(useful, 6),
+                "waste_s": {c: round(v, 6) for c, v in waste.items()},
+                "dominant_waste": self._dominant_waste(waste)}
+            if busy > 0:
+                fleet_goodput["goodput_ratio"] = round(useful / busy, 6)
+        straggler_causes = {
+            h: self._dominant_waste(goodputs.get(h, {}).get("waste_s"))
+            for h in stragglers}
         if self.metrics is not None:
             self.metrics.set_gauge("app_fleet_pass_skew",
                                    round(pass_skew, 4))
@@ -404,18 +444,25 @@ class ControlPlaneLeader:
                                    round(occ_skew, 4))
             self.metrics.set_gauge("app_fleet_straggler_ratio",
                                    round(ratio, 4))
+            if fleet_goodput.get("goodput_ratio") is not None:
+                self.metrics.set_gauge("app_fleet_goodput_ratio",
+                                       fleet_goodput["goodput_ratio"])
         if new and self.logger:
             for host in sorted(new):
                 self.logger.warn(
                     "straggler detected: pass duration skewed off the "
                     "fleet median", host=host,
                     p95_s=p95s.get(host), median_s=round(med, 6),
-                    skew=round(pass_skew, 3), threshold=threshold)
+                    skew=round(pass_skew, 3), threshold=threshold,
+                    # why is it slow? its own waste ledger answers
+                    dominant_waste=straggler_causes.get(host))
         return {"pass_skew": round(pass_skew, 4),
                 "occupancy_skew": round(occ_skew, 4),
                 "straggler_ratio": round(ratio, 4),
                 "stragglers": stragglers,
+                "straggler_causes": straggler_causes,
                 "worst_host": worst,
+                "goodput": fleet_goodput,
                 "threshold": threshold}
 
     # ------------------------------------------------------ fleet views

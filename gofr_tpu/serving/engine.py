@@ -105,6 +105,13 @@ class GenRequest:
                                    # busy span (busy/occupancy per pass,
                                    # accumulated at collect — host float
                                    # adds on an existing loop)
+    waste_recompute_s: float = 0.0  # slice of device_s re-prefilling KV
+                                    # this request already computed once
+                                    # (preemption-by-recompute) — the
+                                    # per-tenant "who pays for
+                                    # preemption" column
+    waste_spec_s: float = 0.0       # slice of device_s spent on this
+                                    # request's REJECTED draft tokens
 
     def _emit(self, token: int | None) -> None:
         if self.out_queue is not None and self.loop is not None:
@@ -280,6 +287,23 @@ class EngineConfig:
     #: replaced by salted hashes (lengths kept) — shippable off-box,
     #: not bit-identity-replayable (serving/observability.py)
     capture_redact: bool = False
+    #: goodput accounting + memory watermarks: classify every pass's
+    #: busy device time into useful vs. waste causes (padding,
+    #: preempt_recompute, spec_rejected, bubble) at collect/retire,
+    #: with useful + sum(waste) == busy conserved, and track KV/prefix/
+    #: host-RSS high-water marks. Host float arithmetic on existing
+    #: collect paths — zero hot-path perturbation (transfer-guard +
+    #: greedy bit-identity hold with it ON). Surfaced as
+    #: app_engine_goodput_ratio / app_engine_waste_seconds{cause} /
+    #: app_engine_*_watermark and GET /debug/efficiency.
+    goodput: bool = True
+    #: recompile sentinel: after warmup() seals the expected shape set,
+    #: a dispatch whose (kind, shape) signature warmup never compiled
+    #: bumps app_engine_recompiles and WARNs once with the offending
+    #: signature — a shape-induced recompile storm names itself before
+    #: p99 does. O(1) host set lookups; engines that never warm up
+    #: never seal, so cold compiles stay silent.
+    recompile_sentinel: bool = True
 
 
 class Engine:
@@ -310,15 +334,29 @@ class Engine:
         #: host timestamps); None = no spans. ``app.serve_model`` wires
         #: the container's tracer here.
         self.tracer = tracer
-        from .observability import (FlightRecorder, UsageLedger,
-                                    WorkloadRecorder)
+        from .observability import (FlightRecorder, GoodputMeter,
+                                    RecompileSentinel, UsageLedger,
+                                    WatermarkTracker, WorkloadRecorder)
         self.recorder = FlightRecorder(config.flight_recorder_size,
                                        config.flight_recorder_requests)
+        #: device-time waste attribution (useful vs padding/
+        #: preempt_recompute/spec_rejected/bubble, conserved against
+        #: busy time); fed at collect/retire on the engine thread
+        self.goodput = GoodputMeter(config.goodput)
+        #: KV/prefix/host-RSS high-water marks (throttled gauge cadence)
+        self.watermarks = WatermarkTracker(config.goodput)
+        #: post-warmup recompile detection by dispatch shape signature
+        self.sentinel = RecompileSentinel(config.recompile_sentinel)
+        if self.goodput.enabled:
+            # heartbeats and workload headers carry the waste digest
+            self.recorder.goodput_source = self.goodput.summary
         #: workload capture ring (armed lazily — see EngineConfig.
         #: workload_capture); engine_seed is stamped below once the
         #: sampling seed resolves
         self.workload = WorkloadRecorder(config.workload_capture_requests,
                                          redact=config.capture_redact)
+        if self.goodput.enabled:
+            self.workload.goodput_source = self.goodput.summary
         #: per-tenant usage metering, fed at retire (_finalize_obs);
         #: always present (host dicts only) — attach_metrics points it
         #: at the metrics manager so app_tenant_* series populate
@@ -680,7 +718,10 @@ class Engine:
                       "spec_accepted": 0, "spec_drafted": 0,
                       "spec_rows": 0, "preemptions": 0,
                       "requeues": 0, "prefix_evictions": 0,
-                      "stalls": 0}
+                      "stalls": 0, "recompiles": 0}
+        #: waste-counter watermark already published to the metrics
+        #: manager (the throttled gauge pass emits deltas)
+        self._waste_published: dict[str, float] = {}
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> None:
@@ -812,6 +853,18 @@ class Engine:
              "decode-path model FLOPs utilization (cost_analysis FLOPs "
              "x tokens/s over the chip peak; 0 when the peak or the "
              "compiled cost is unknown)"),
+            ("app_engine_goodput_ratio",
+             "useful device time over total busy device time "
+             "(1 - waste; see app_engine_waste_seconds for the causes)"),
+            ("app_engine_kv_pages_watermark",
+             "high-water mark of KV pool pages in use (paged layout)"),
+            ("app_engine_kv_rows_watermark",
+             "high-water mark of live KV rows (slot layout)"),
+            ("app_engine_prefix_pages_watermark",
+             "high-water mark of page references pinned by the prefix "
+             "cache"),
+            ("app_engine_host_rss_bytes_watermark",
+             "host process RSS high-water mark (ru_maxrss)"),
         ):
             if metrics.get(name) is None:
                 metrics.new_gauge(name, desc)
@@ -845,6 +898,16 @@ class Engine:
             ("app_tenant_device_seconds",
              "device busy time attributed to each tenant (per-request "
              "share of every pass's busy span)"),
+            ("app_tenant_waste_seconds",
+             "per-tenant attributable waste device time by cause "
+             "(preempt_recompute, spec_rejected)"),
+            ("app_engine_waste_seconds",
+             "busy device time classified as waste, by cause (padding/"
+             "preempt_recompute/spec_rejected/bubble); useful + waste "
+             "== busy is conserved"),
+            ("app_engine_recompiles",
+             "unexpected post-warmup XLA recompiles detected by the "
+             "dispatch-shape sentinel"),
         ):
             if metrics.get(name) is None:
                 metrics.new_counter(name, desc)
@@ -908,6 +971,7 @@ class Engine:
         buckets = {self._bucket_for(int(n)) for n in prompt_lens}
         for bucket in sorted(buckets):
             for g in self._group_sizes():
+                self.sentinel.observe(("prefill", bucket, g))
                 if paged:  # all-OOB tables: every write drops
                     slots = jnp.full((g, self._pages_per_slot),
                                      self._n_pages, jnp.int32)
@@ -925,6 +989,8 @@ class Engine:
             b = cfg.max_batch
             tables = (jnp.full((b, self._pages_per_slot), self._n_pages,
                                jnp.int32),) if paged else ()
+            for w in (0, *self._decode_windows):
+                self.sentinel.observe(("decode", w))
             variants = [self._decode] + [
                 self._decode_by_window[w] for w in self._decode_windows]
             for fn in variants:
@@ -976,6 +1042,7 @@ class Engine:
                     if cw is not None and width > cw:
                         continue  # the dispatcher never picks cw then
                     for g in sorted({1, P}):
+                        self.sentinel.observe(("chunk", width, g, cw))
                         if paged:
                             slot_arg = jnp.full(
                                 (g, self._pages_per_slot),
@@ -993,6 +1060,11 @@ class Engine:
                             jnp.zeros(g, jnp.int32),
                             self._prefill_base_key)
                         jax.block_until_ready(toks)
+        if self._spec_enabled:
+            # the verify graph's width is static and its lazy first
+            # compile on the serving path is expected, not a regression
+            self.sentinel.observe(("spec_verify", cfg.spec_draft + 1))
+        self.sentinel.seal()
 
     def _clamp_prompt(self, tokens: list[int], max_new: int) -> list[int]:
         """Keep the tail of an over-long prompt, reserving room to
@@ -1394,7 +1466,9 @@ class Engine:
                                                 width)
                         call = (self._get_chunk_prefill(cw) if cw
                                 else fn)
+                        self._note_dispatch_shape("chunk", width, G, cw)
                         c0 = time.perf_counter()
+                        self.goodput.note_dispatch(c0)
                         w0 = time.time()
                         toks, self.k_cache, self.v_cache = call(
                             self.params, jnp.asarray(tokens),
@@ -1415,9 +1489,19 @@ class Engine:
                                 dur=round(c_dur, 6),
                                 view_avoided=self._native_chunk,
                                 queue_depth=self.waiting.qsize())
+                        # goodput: a walker with a first token already
+                        # emitted is re-prefilling KV it computed once
+                        # (preemption recompute); pad rows are padding
+                        recomp = sum(1 for r in ready
+                                     if r.first_token_at is not None)
+                        self.goodput.add_prefill(
+                            "prefill_chunk", c_dur, G,
+                            len(ready) - recomp, recomp)
                         w1 = time.time()
                         for r in ready:
                             r.device_s += c_dur / len(ready)
+                            if r.first_token_at is not None:
+                                r.waste_recompute_s += c_dur / len(ready)
                             self._req_event(
                                 r, "prefill", w0, w1,
                                 {"bucket": width,
@@ -1447,6 +1531,8 @@ class Engine:
                 self.logger.error(f"chunked prefill failed: {exc!r}")
             self._recover_lost_cache(exc)
         self._note_prefill_span(start)
+        self._update_kv_watermarks()
+        self._note_device_idle()
         for r in walkers:  # more chunks next pass
             if owns_slot(r) and r.pending_prefill \
                     and r.prefill_offset < len(r.prompt_tokens):
@@ -1698,6 +1784,37 @@ class Engine:
             self.k_cache, self.v_cache = self._make_cache(
                 cfg.max_batch, cfg.max_seq)
 
+    def _note_dispatch_shape(self, *sig: Any) -> None:
+        """Recompile-sentinel hook at every device dispatch site: a
+        novel post-warmup shape signature means XLA is lowering a new
+        graph on the serving path — count it and WARN once with the
+        offending shape (O(1) host set lookup otherwise)."""
+        if not self.sentinel.dispatch(sig):
+            return
+        self.stats["recompiles"] += 1
+        if self.metrics is not None:
+            self.metrics.increment_counter("app_engine_recompiles")
+        if self.logger is not None:
+            self.logger.warn(
+                "unexpected post-warmup recompile: dispatch shape was "
+                "never compiled during warmup",
+                signature="/".join(str(p) for p in sig))
+
+    def _note_device_idle(self) -> None:
+        """Goodput bubble tracking: a synchronous collect finished and
+        no dispatched pass remains in flight — from the host's view the
+        device is idle. Record whether work was waiting (queued,
+        requeued, or active slots mid-generation) so the gap until the
+        next dispatch can be classified as bubble waste."""
+        if not self.goodput.enabled:
+            return
+        if self._pending or self._pending_prefills:
+            return  # a pass is still in flight: the device isn't idle
+        backlog = (bool(self._requeued) or self.waiting.qsize() > 0
+                   or any(r is not None and not r.pending_prefill
+                          for r in self.active))
+        self.goodput.note_pass_end(time.perf_counter(), backlog)
+
     def _req_event(self, req: GenRequest, name: str, t0: float,
                    t1: float, attrs: dict | None = None) -> None:
         """Append a lifecycle event (bounded) — spans and the flight
@@ -1751,7 +1868,9 @@ class Engine:
                 tenant=req.tenant or "anonymous", status=status,
                 prompt_tokens=len(req.prompt_tokens),
                 completion_tokens=n, queue_s=queue_s, e2e_s=e2e_s,
-                device_s=req.device_s, t=end)
+                device_s=req.device_s,
+                waste_recompute_s=req.waste_recompute_s,
+                waste_spec_s=req.waste_spec_s, t=end)
         if self.slo is not None and not req.cancelled:
             self.slo.record(self.slo.judge(
                 error=req.error, ttft_s=ttft_s, tpot_s=tpot_s,
@@ -1885,7 +2004,9 @@ class Engine:
         # for a [1..2, bucket] forward, bursts amortise the full width
         P = next(g for g in self._group_sizes() if g >= len(placed))
         self._rng_step += 1
+        self._note_dispatch_shape("prefill", bucket, P)
         start = time.perf_counter()
+        self.goodput.note_dispatch(start)
         try:
             tokens = np.zeros((P, bucket), np.int32)
             kv_len = np.ones(P, np.int32)                # dummy rows: length 1
@@ -1982,14 +2103,25 @@ class Engine:
                     dur=round(pass_dur, 6),
                     occupancy=sum(r is not None for r in self.active),
                     queue_depth=self.waiting.qsize())
+            fresh_rows = recompute_rows = 0
             for row, (req, slot, epoch) in enumerate(
                     zip(rec["placed"], rec["slots"], rec["epochs"])):
                 if (req.prefill_epoch != epoch
                         or self.active[slot] is not req
                         or req.finished_at is not None):
-                    continue  # preempted/retired/re-admitted since
+                    # preempted/retired/re-admitted since: the row's
+                    # compute is discarded — preemption-class waste
+                    recompute_rows += 1
+                    continue
                 req.pending_prefill = False
                 req.device_s += pass_share
+                if req.first_token_at is not None:
+                    # a recompute row: the KV it just prefilled was
+                    # already computed in its pre-preemption life
+                    recompute_rows += 1
+                    req.waste_recompute_s += pass_share
+                else:
+                    fresh_rows += 1
                 self._req_event(req, "prefill", rec.get("wall0", now),
                                 now, {"bucket": rec.get("bucket"),
                                       "rows": len(rec["placed"])})
@@ -2008,6 +2140,11 @@ class Engine:
                 self.lengths[slot] = len(req.prompt_tokens)
                 if self._finished(req, first):
                     self._retire(slot)
+            self.goodput.add_prefill("prefill", pass_dur,
+                                     int(toks_np.shape[0]), fresh_rows,
+                                     recompute_rows)
+            self._update_kv_watermarks()
+        self._note_device_idle()
 
     def _note_view_avoided(self, n_rows: int) -> None:
         """Account HBM bytes a dense-view round trip would have moved
@@ -2240,6 +2377,7 @@ class Engine:
         # are garbage — account the valid prefix NOW on the host mirror
         # (the graph advances the device lengths with the same clamp)
         decode = self._decode
+        win = 0
         if self._decode_windows:
             # smallest compiled window covering every live row this
             # pass will touch (len + T); pending-prefill slots carry
@@ -2249,13 +2387,16 @@ class Engine:
             for w in self._decode_windows:
                 if needed <= w:
                     decode = self._decode_by_window[w]
+                    win = w
                     break
+        self._note_dispatch_shape("decode", win)
         valid = np.where(active_mask,
                          np.minimum(T, cfg.max_seq - self.lengths),
                          0).astype(np.int32)
         self.lengths += valid
 
         start = time.perf_counter()
+        self.goodput.note_dispatch(start)
         prev = (self._dev_last if self._dev_last is not None
                 else self._dev_zero)
         tables = (self._tables_arg(),) if paged else ()
@@ -2310,7 +2451,11 @@ class Engine:
             self.metrics.record_histogram("app_engine_batch_occupancy",
                                           float(occupancy))
         self._step_count += 1
+        # KV watermark BEFORE retires zero the finishing slots: the
+        # dispatch already advanced lengths, so this is the pass peak
+        self._update_kv_watermarks()
         emitted = 0
+        credited = 0  # rows whose request actually kept this pass
         share = busy / occupancy if occupancy else 0.0
         for i, req in enumerate(rec["reqs"]):
             if req is None or not rec["mask"][i]:
@@ -2321,6 +2466,7 @@ class Engine:
             # evenly across its occupied rows — the per-tenant
             # device_seconds the usage ledger accounts at retire
             req.device_s += share
+            credited += 1
             done = False
             for k in range(int(rec["valid"][i])):
                 token = int(step_np[k, i])
@@ -2335,6 +2481,10 @@ class Engine:
                 self._retire(i)
         collect = time.perf_counter() - end
         self.stats["collect_s"] += collect
+        # goodput: rows that kept the pass are useful; empty slots,
+        # pending-prefill sentinels and retired requests riding out a
+        # pipelined pass are padding waste
+        self.goodput.add_decode(busy, credited, self.config.max_batch)
         if self.recorder.enabled:
             # the pass record: everything here is a host int/float the
             # collect already computed — no device reads beyond the
@@ -2346,6 +2496,7 @@ class Engine:
                 queue_depth=self.waiting.qsize(), tokens=emitted,
                 h2d=rec.get("h2d", 0),
                 preemptions=self.stats["preemptions"])
+        self._note_device_idle()
 
     # ------------------------------------------------- speculative decode
     def _get_spec_verify(self) -> Callable:
@@ -2502,7 +2653,9 @@ class Engine:
                     self._preempt(i)
         tables = (self._tables_arg(),) if paged else ()
         self._rng_step += 1
+        self._note_dispatch_shape("spec_verify", width)
         start = time.perf_counter()
+        self.goodput.note_dispatch(start)
         w0 = time.time()
         fn = self._get_spec_verify()
         accepted_dev, bonus_dev, self.k_cache, self.v_cache = fn(
@@ -2516,18 +2669,27 @@ class Engine:
         if self._native_verify:
             self._note_view_avoided(b)
         self._note_pass("spec_passes", start)
+        spec_dur = time.perf_counter() - start
         w1 = time.time()
         pass_drafted = pass_accepted = pass_rows = 0
+        row_stats: list[tuple[int, int]] = []  # (drafted, accepted)
         live = sum(1 for r in self.active
                    if r is not None and not r.pending_prefill)
-        verify_share = ((time.perf_counter() - start) / live) if live \
-            else 0.0
+        verify_share = (spec_dur / live) if live else 0.0
         for i, req in enumerate(self.active):
             if req is None or req.pending_prefill:
                 continue
             req.device_s += verify_share
             n_acc = int(accepted[i])
             n_drafted = len(proposals.get(i, []))
+            if n_drafted:
+                # the rejected-draft slice of this row's device time:
+                # positions computed and thrown away, billed to the
+                # tenant that drafted them
+                req.waste_spec_s += verify_share \
+                    * (n_drafted - min(n_acc, n_drafted)) \
+                    / (1 + n_drafted)
+            row_stats.append((n_drafted, n_acc))
             pass_drafted += n_drafted
             pass_accepted += n_acc
             pass_rows += 1
@@ -2570,6 +2732,8 @@ class Engine:
                                      float(pass_drafted))
             self.metrics.add_counter("app_engine_spec_accepted",
                                      float(pass_accepted))
+        self.goodput.add_spec(spec_dur, b, row_stats)
+        self._update_kv_watermarks()
         if self.recorder.enabled:
             self.recorder.record_pass(
                 "spec_verify", rows=pass_rows, drafted=pass_drafted,
@@ -2577,26 +2741,84 @@ class Engine:
                 dur=round(time.perf_counter() - start, 6),
                 occupancy=pass_rows,
                 queue_depth=self.waiting.qsize())
+        self._note_device_idle()
+
+    def _update_kv_watermarks(self) -> None:
+        """KV high-water marks, sampled at collect sites so a short
+        burst's peak is caught before its slots retire — an O(1) page
+        count (paged) or an O(max_batch) length sum (slot), pure host
+        compares."""
+        wm = self.watermarks
+        if not wm.enabled:
+            return
+        if self.config.kv_layout == "paged":
+            wm.update("kv_pages",
+                      float(self._n_pages - len(self._free_pages)))
+            wm.update("prefix_pages", float(self._cached_pages))
+        else:
+            wm.update("kv_rows", float(self.lengths.sum()))
+
+    def _update_watermarks(self) -> None:
+        """Advance every memory high-water mark (throttled cadence):
+        the KV marks plus host RSS (one getrusage syscall)."""
+        wm = self.watermarks
+        if not wm.enabled:
+            return
+        self._update_kv_watermarks()
+        wm.update_rss()
+
+    def efficiency_state(self) -> dict:
+        """The ``GET /debug/efficiency`` payload for this engine:
+        goodput classification, memory watermarks, recompile sentinel
+        state — all host-side reads."""
+        self._update_watermarks()
+        return {"goodput": self.goodput.state(),
+                "watermarks": self.watermarks.state(),
+                "recompiles": self.sentinel.state()}
 
     def _update_gauges(self) -> None:
-        if self.metrics is None:
-            return
-        self.metrics.set_gauge(
-            "app_engine_active_slots",
-            float(sum(r is not None for r in self.active)))
-        self.metrics.set_gauge("app_engine_waiting",
-                               float(self.waiting.qsize()))
-        # derived gauges, throttled: pure host arithmetic over counters
-        # the loop already maintains — never a device sync
+        m = self.metrics
+        if m is not None:
+            m.set_gauge(
+                "app_engine_active_slots",
+                float(sum(r is not None for r in self.active)))
+            m.set_gauge("app_engine_waiting",
+                        float(self.waiting.qsize()))
+        # derived gauges + watermarks, throttled: pure host arithmetic
+        # over counters the loop already maintains — never a device sync
         now = time.time()
         dt = now - self._gauge_wall
         if dt < 0.25:
             return
-        m = self.metrics
+        self._update_watermarks()
         tps = (self.total_generated - self._gauge_tokens) / dt
         self._gauge_wall = now
         self._gauge_tokens = self.total_generated
+        if m is None:
+            return
         m.set_gauge("app_engine_tokens_per_second", round(tps, 2))
+        gp = self.goodput
+        if gp.enabled and gp.busy_s > 0:
+            m.set_gauge("app_engine_goodput_ratio",
+                        round(gp.useful_s / gp.busy_s, 6))
+            for cause, total in gp.waste_s.items():
+                delta = total - self._waste_published.get(cause, 0.0)
+                if delta > 0:  # counters take deltas, the meter totals
+                    m.add_counter("app_engine_waste_seconds", delta,
+                                  cause=cause)
+                    self._waste_published[cause] = total
+        wm = self.watermarks
+        if wm.enabled:
+            for mark, gauge in (
+                ("kv_pages", "app_engine_kv_pages_watermark"),
+                ("kv_rows", "app_engine_kv_rows_watermark"),
+                ("prefix_pages", "app_engine_prefix_pages_watermark"),
+                ("host_rss_bytes",
+                 "app_engine_host_rss_bytes_watermark"),
+            ):
+                value = wm.get(mark)
+                if value is not None:
+                    m.set_gauge(gauge, value)
         mfu = (tps * self._flops_per_token / self._peak_flops
                if self._flops_per_token and self._peak_flops else 0.0)
         m.set_gauge("app_engine_mfu", round(mfu, 6))

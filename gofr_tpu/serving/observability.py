@@ -45,6 +45,10 @@ class FlightRecorder:
         self._requests: deque = deque(maxlen=max(1, int(request_logs)))
         self._seq = 0
         self._by_kind: dict[str, int] = {}
+        #: optional () -> goodput summary (GoodputMeter.summary); the
+        #: engine wires its meter here so fleet_summary carries the
+        #: waste breakdown and the leader can say WHY a host is slow
+        self.goodput_source: Any = None
 
     # ------------------------------------------------------------ writers
     def record_pass(self, kind: str, **fields: Any) -> None:
@@ -105,6 +109,15 @@ class FlightRecorder:
             if span > 0:
                 out["tokens_per_s"] = round(
                     sum(p["tokens"] for p in timed[1:]) / span, 2)
+        if self.goodput_source is not None:
+            try:
+                g = self.goodput_source() or {}
+            except Exception:
+                g = {}
+            for key in ("goodput_ratio", "busy_s", "useful_s",
+                        "waste_s"):
+                if g.get(key) is not None:
+                    out[key] = g[key]
         return out
 
     def dump(self, logger: Any, reason: str = "") -> None:
@@ -138,6 +151,280 @@ def request_summary(req: Any) -> dict:
         "events": [{"name": name, "t0": t0, "t1": t1, **(attrs or {})}
                    for name, t0, t1, attrs in req.events],
     }
+
+
+# ------------------------------------------------- goodput accounting
+class GoodputMeter:
+    """Device-time waste attribution with a hard conservation
+    invariant: every accounted device-second is classified as
+    ``useful`` or one of the waste causes, and
+
+        ``useful_s + sum(waste_s.values()) == busy_s``
+
+    holds at all times (useful is computed as the residual of each
+    pass's classification, so the identity is structural, not
+    statistical — tests pin it across every pass kind).
+
+    Causes (the taxonomy ``/debug/efficiency`` and
+    ``app_engine_waste_seconds{cause}`` expose):
+
+    - ``padding`` — inactive/pad rows in a dispatched fixed-shape
+      batch: empty decode slots, dummy prefill-group rows, verify rows
+      discarded before collect. The kernels tolerate them by design;
+      the meter prices them.
+    - ``preempt_recompute`` — prefill time spent re-computing KV a
+      preempted request already produced once (vLLM-style
+      preemption-by-recompute), plus batch-prefill rows orphaned by a
+      preemption mid-flight.
+    - ``spec_rejected`` — the drafted-minus-accepted fraction of each
+      speculative verify row: positions computed and thrown away.
+    - ``bubble`` — wall-clock gaps between a collect completing with
+      NOTHING left in flight and the next dispatch, while work was
+      waiting (queued, requeued or active). Host scheduling overhead
+      the device spends idle — the dispatch-bound regime BENCH_r05
+      measured, now a named number.
+
+    Everything is engine-thread float arithmetic at dispatch/collect —
+    the same single-writer discipline as the FlightRecorder; no locks,
+    no device syncs, zero hot-path perturbation (the transfer-guard
+    and greedy bit-identity tests run with the meter ON). ``busy_s``
+    sums per-pass durations, so with pipelining it may exceed wall
+    time — it is an attribution base, not a wall clock.
+    """
+
+    CAUSES = ("padding", "preempt_recompute", "spec_rejected", "bubble")
+
+    def __init__(self, enabled: bool = True) -> None:
+        self.enabled = bool(enabled)
+        self.reset()
+
+    def reset(self) -> None:
+        self.busy_s = 0.0
+        self.useful_s = 0.0
+        self.waste_s = {c: 0.0 for c in self.CAUSES}
+        self.passes = 0
+        #: per-pass-kind sub-ledger for the /debug/efficiency rollup
+        self.by_kind: dict[str, dict] = {}
+        self._free_at: float | None = None
+        self._backlog = False
+
+    # ------------------------------------------------------------ feeds
+    def _account(self, kind: str, busy: float, useful: float,
+                 **wastes: float) -> None:
+        self.busy_s += busy
+        self.useful_s += useful
+        sub = self.by_kind.setdefault(
+            kind, {"busy_s": 0.0, "useful_s": 0.0,
+                   **{c: 0.0 for c in self.CAUSES}})
+        sub["busy_s"] += busy
+        sub["useful_s"] += useful
+        for cause, amount in wastes.items():
+            if amount:
+                self.waste_s[cause] += amount
+                sub[cause] += amount
+        self.passes += 1
+
+    def add_decode(self, busy: float, served_rows: int,
+                   batch: int) -> None:
+        """A decode pass: the graph always runs the full ``batch``
+        shape; rows that emitted no kept tokens (empty slots,
+        pending-prefill sentinels, retired requests riding out a
+        pipelined pass) are padding."""
+        if not self.enabled or busy <= 0 or batch <= 0:
+            return
+        served = max(0, min(int(served_rows), batch))
+        useful = busy * served / batch
+        self._account("decode", busy, useful, padding=busy - useful)
+
+    def add_prefill(self, kind: str, busy: float, group: int,
+                    fresh_rows: int, recompute_rows: int) -> None:
+        """A (batch or chunk) prefill dispatch of ``group`` padded
+        rows: ``fresh_rows`` computed new KV, ``recompute_rows``
+        re-prefilled a preempted request's history (or were orphaned
+        by one), the rest were dummy pad rows."""
+        if not self.enabled or busy <= 0 or group <= 0:
+            return
+        share = busy / group
+        fresh = max(0, min(int(fresh_rows), group))
+        recomp = max(0, min(int(recompute_rows), group - fresh))
+        self._account(kind, busy, fresh * share,
+                      preempt_recompute=recomp * share,
+                      padding=(group - fresh - recomp) * share)
+
+    def add_spec(self, busy: float, batch: int,
+                 rows: list[tuple[int, int]]) -> None:
+        """A speculative verify pass over a full-``batch`` graph.
+        ``rows`` carries one ``(drafted, accepted)`` pair per row that
+        survived to collect; each row's useful fraction is the emitted
+        tokens (accepted + bonus) over its fed positions
+        (1 + drafted), the rejected remainder is ``spec_rejected``,
+        and rows not fed (or discarded by a mid-pass preemption) are
+        padding."""
+        if not self.enabled or busy <= 0 or batch <= 0:
+            return
+        share = busy / batch
+        useful = rejected = 0.0
+        for drafted, accepted in rows:
+            drafted = max(0, int(drafted))
+            accepted = max(0, min(int(accepted), drafted))
+            useful += share * (1 + accepted) / (1 + drafted)
+            rejected += share * (drafted - accepted) / (1 + drafted)
+        self._account("spec_verify", busy, useful,
+                      spec_rejected=rejected,
+                      padding=max(0, batch - len(rows)) * share)
+
+    def note_pass_end(self, t: float, backlog: bool) -> None:
+        """The device went idle at host time ``t`` (a collect finished
+        with nothing left in flight). ``backlog`` records whether work
+        was waiting — only then does the gap to the next dispatch
+        count as a bubble."""
+        if self.enabled:
+            self._free_at = t
+            self._backlog = bool(backlog)
+
+    def note_dispatch(self, t: float) -> None:
+        """A device dispatch at host time ``t`` closes any open idle
+        gap; with backlog pending, the gap was a bubble: device-time
+        lost to host-side scheduling while requests waited."""
+        if not self.enabled or self._free_at is None:
+            return
+        gap = t - self._free_at
+        self._free_at = None
+        if self._backlog and gap > 0:
+            self.busy_s += gap
+            self.waste_s["bubble"] += gap
+
+    # ---------------------------------------------------------- readers
+    def summary(self) -> dict:
+        """The compact digest: heartbeat summaries, workload headers,
+        the bench payload."""
+        busy = self.busy_s
+        out = {"busy_s": round(busy, 6),
+               "useful_s": round(self.useful_s, 6),
+               "waste_s": {c: round(v, 6)
+                           for c, v in self.waste_s.items()}}
+        if busy > 0:
+            out["goodput_ratio"] = round(self.useful_s / busy, 6)
+        return out
+
+    def dominant_waste(self) -> str | None:
+        worst = max(self.waste_s, key=self.waste_s.get, default=None)
+        return worst if worst and self.waste_s[worst] > 0 else None
+
+    def state(self) -> dict:
+        """The full ``/debug/efficiency`` payload: totals, per-kind
+        breakdown, dominant cause, and the conservation residual (a
+        float-epsilon health check on the invariant itself)."""
+        out = self.summary()
+        out["enabled"] = self.enabled
+        out["passes"] = self.passes
+        out["dominant_waste"] = self.dominant_waste()
+        out["by_kind"] = {k: {kk: round(vv, 6) for kk, vv in sub.items()}
+                          for k, sub in self.by_kind.items()}
+        out["conservation_error_s"] = round(
+            self.busy_s - self.useful_s - sum(self.waste_s.values()), 9)
+        return out
+
+
+class WatermarkTracker:
+    """Memory high-water marks with timestamps: KV-pool pages (or rows
+    for the slot layout), prefix-cache pages, and host RSS. Updated on
+    the engine's throttled gauge cadence — pure host compares, monotone
+    non-decreasing within a run by construction. Served in
+    ``/debug/efficiency`` and as ``app_engine_*_watermark`` gauges."""
+
+    def __init__(self, enabled: bool = True) -> None:
+        self.enabled = bool(enabled)
+        self._marks: dict[str, dict] = {}
+
+    def update(self, name: str, value: float,
+               t: float | None = None) -> bool:
+        """Record ``value`` if it is a new high-water mark; returns
+        True when the mark advanced."""
+        if not self.enabled:
+            return False
+        mark = self._marks.get(name)
+        if mark is not None and value <= mark["value"]:
+            return False
+        self._marks[name] = {"value": value,
+                             "t": time.time() if t is None else t}
+        return True
+
+    def update_rss(self) -> None:
+        """Host RSS high-water mark from the kernel's own accounting
+        (``ru_maxrss`` is already a max — one cheap syscall)."""
+        if not self.enabled:
+            return
+        try:
+            import resource
+            kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            self.update("host_rss_bytes", float(kb) * 1024.0)
+        except Exception:
+            pass
+
+    def get(self, name: str) -> float | None:
+        mark = self._marks.get(name)
+        return mark["value"] if mark is not None else None
+
+    def state(self) -> dict:
+        return {name: dict(mark) for name, mark in self._marks.items()}
+
+
+class RecompileSentinel:
+    """Detects unexpected post-warmup XLA recompiles from dispatch
+    shape signatures.
+
+    The engine's graphs are keyed by static shape tuples — prefill
+    (bucket, group), chunk (width, group, window), decode (window),
+    verify (draft width). ``warmup()`` observes every signature it
+    compiles, then ``seal()``s the sentinel; after that, the first
+    dispatch of a NOVEL signature is, by construction, a lowering the
+    warmup did not cover — a serving-path recompile. The engine bumps
+    ``app_engine_recompiles`` and WARNs once per signature with the
+    offending shape, so a shape-induced recompile storm names itself
+    instead of surfacing as an unexplained p99 explosion.
+
+    Host-side set lookups at dispatch time — O(1), no device work.
+    Engines that never warm up never seal, so the sentinel stays
+    silent (everything is an expected cold compile then)."""
+
+    MAX_SIGNATURES = 32
+
+    def __init__(self, enabled: bool = True) -> None:
+        self.enabled = bool(enabled)
+        self.sealed = False
+        self.recompiles = 0
+        self.signatures: list[str] = []
+        self._seen: set = set()
+
+    def observe(self, sig: tuple) -> None:
+        """Seed an expected signature (warmup-time compiles)."""
+        if self.enabled:
+            self._seen.add(sig)
+
+    def seal(self) -> None:
+        """Warmup is done: novel signatures are recompiles from now."""
+        self.sealed = True
+
+    def dispatch(self, sig: tuple) -> bool:
+        """Note a dispatch; True when it is a novel POST-warmup shape
+        (fires exactly once per signature — the repeat dispatch hits a
+        warm graph and stays silent)."""
+        if not self.enabled or sig in self._seen:
+            return False
+        self._seen.add(sig)
+        if not self.sealed:
+            return False
+        self.recompiles += 1
+        if len(self.signatures) < self.MAX_SIGNATURES:
+            self.signatures.append("/".join(str(p) for p in sig))
+        return True
+
+    def state(self) -> dict:
+        return {"enabled": self.enabled, "sealed": self.sealed,
+                "recompiles": self.recompiles,
+                "signatures": list(self.signatures),
+                "known_shapes": len(self._seen)}
 
 
 # ------------------------------------------------- workload capture
@@ -195,6 +482,10 @@ class WorkloadRecorder:
         self._records: deque = deque(maxlen=max(1, self.size))
         self._seq = 0
         self._dropped = 0
+        #: optional () -> GoodputMeter.summary, wired by the engine:
+        #: the header then carries the capture-side efficiency digest
+        #: so a replay can compare waste breakdowns, not just tokens
+        self.goodput_source: Any = None
 
     # ------------------------------------------------------------ control
     def start(self, redact: bool | None = None) -> dict:
@@ -274,10 +565,20 @@ class WorkloadRecorder:
 
     # ------------------------------------------------------------ readers
     def header(self) -> dict:
-        return {"format": WORKLOAD_FORMAT, "version": WORKLOAD_VERSION,
-                "redacted": self.redact, "engine_seed": self.engine_seed,
-                "started_at": self.started_at, "recorded": self._seq,
-                "dropped": self._dropped}
+        out = {"format": WORKLOAD_FORMAT, "version": WORKLOAD_VERSION,
+               "redacted": self.redact, "engine_seed": self.engine_seed,
+               "started_at": self.started_at, "recorded": self._seq,
+               "dropped": self._dropped}
+        if self.goodput_source is not None:
+            # additive field (same WORKLOAD_VERSION): readers that
+            # predate it simply ignore the key
+            try:
+                g = self.goodput_source()
+                if g and g.get("busy_s"):
+                    out["goodput"] = g
+            except Exception:
+                pass
+        return out
 
     def snapshot(self, n: int | None = None) -> dict:
         records = list(self._records)
@@ -389,11 +690,18 @@ class UsageLedger:
     def _blank() -> dict:
         return {"requests": {}, "prompt_tokens": 0,
                 "completion_tokens": 0, "device_s": 0.0,
-                "queue_s": 0.0, "e2e_s": 0.0}
+                "queue_s": 0.0, "e2e_s": 0.0,
+                # who pays for inefficiency: the slice of this
+                # tenant's device_s that was preemption recompute or
+                # rejected speculation (padding/bubbles are systemic,
+                # not attributable to one principal)
+                "waste_recompute_s": 0.0, "waste_spec_s": 0.0}
 
     def record(self, *, tenant: str, status: str, prompt_tokens: int,
                completion_tokens: int, queue_s: float = 0.0,
                e2e_s: float = 0.0, device_s: float = 0.0,
+               waste_recompute_s: float = 0.0,
+               waste_spec_s: float = 0.0,
                t: float | None = None) -> None:
         t = time.time() if t is None else t
         with self._lock:
@@ -404,12 +712,16 @@ class UsageLedger:
             tot["device_s"] += float(device_s)
             tot["queue_s"] += float(queue_s)
             tot["e2e_s"] += float(e2e_s)
+            tot["waste_recompute_s"] += float(waste_recompute_s)
+            tot["waste_spec_s"] += float(waste_spec_s)
             self._events.append(
                 {"t": t, "tenant": tenant, "status": status,
                  "prompt_tokens": int(prompt_tokens),
                  "completion_tokens": int(completion_tokens),
                  "device_s": float(device_s), "queue_s": float(queue_s),
-                 "e2e_s": float(e2e_s)})
+                 "e2e_s": float(e2e_s),
+                 "waste_recompute_s": float(waste_recompute_s),
+                 "waste_spec_s": float(waste_spec_s)})
         m = self.metrics
         if m is None:
             return
@@ -424,6 +736,14 @@ class UsageLedger:
         if device_s > 0:
             m.add_counter("app_tenant_device_seconds", float(device_s),
                           tenant=tenant)
+        if waste_recompute_s > 0:
+            m.add_counter("app_tenant_waste_seconds",
+                          float(waste_recompute_s), tenant=tenant,
+                          cause="preempt_recompute")
+        if waste_spec_s > 0:
+            m.add_counter("app_tenant_waste_seconds",
+                          float(waste_spec_s), tenant=tenant,
+                          cause="spec_rejected")
         m.record_histogram("app_tenant_queue_seconds", float(queue_s),
                            tenant=tenant)
         m.record_histogram("app_tenant_e2e_seconds", float(e2e_s),
@@ -455,16 +775,18 @@ class UsageLedger:
                     tot["requests"][ev["status"]] = \
                         tot["requests"].get(ev["status"], 0) + 1
                     for key in ("prompt_tokens", "completion_tokens",
-                                "device_s", "queue_s", "e2e_s"):
-                        tot[key] += ev[key]
+                                "device_s", "queue_s", "e2e_s",
+                                "waste_recompute_s", "waste_spec_s"):
+                        tot[key] += ev.get(key, 0)
                 partial = bool(self._events) and \
                     self._events[0]["t"] > cutoff and \
                     len(self._events) == self._events.maxlen
                 out = {"window": _fmt_window(window_s),
                        "tenants": per_tenant, "partial": partial}
         for tot in out["tenants"].values():
-            for key in ("device_s", "queue_s", "e2e_s"):
-                tot[key] = round(tot[key], 6)
+            for key in ("device_s", "queue_s", "e2e_s",
+                        "waste_recompute_s", "waste_spec_s"):
+                tot[key] = round(tot.get(key, 0.0), 6)
         return out
 
 

@@ -112,6 +112,39 @@ def _first_divergence(recorded: list, replayed: list) -> int:
     return min(len(recorded), len(replayed))
 
 
+# ------------------------------------------------ efficiency divergence
+def _waste_shares(goodput: dict | None) -> dict:
+    """Waste per cause as a FRACTION of busy time — the scale-free
+    form two runs of different lengths can be compared in."""
+    if not isinstance(goodput, dict):
+        return {}
+    busy = float(goodput.get("busy_s") or 0.0)
+    if busy <= 0:
+        return {}
+    return {cause: float(v or 0.0) / busy
+            for cause, v in (goodput.get("waste_s") or {}).items()}
+
+
+def efficiency_divergence(recorded: dict | None,
+                          replayed: dict | None) -> list[dict]:
+    """Waste causes whose replayed share of busy device time
+    materially exceeds the capture's (more than doubled, past a 2%
+    absolute floor). A replay that matches every token but doubles
+    ``preempt_recompute`` is a scheduler regression the token diff
+    cannot see — this names it."""
+    rec, rep = _waste_shares(recorded), _waste_shares(replayed)
+    if not rec or not rep:
+        return []
+    out = []
+    for cause in sorted(set(rec) | set(rep)):
+        a, b = rec.get(cause, 0.0), rep.get(cause, 0.0)
+        if b > 2.0 * a + 0.02:
+            out.append({"cause": cause,
+                        "recorded_share": round(a, 4),
+                        "replayed_share": round(b, 4)})
+    return out
+
+
 # -------------------------------------------------------------- replay
 def replay_workload(engine: Any, workload: dict, *, speed: float = 1.0,
                     closed_loop: int = 0,
@@ -135,6 +168,11 @@ def replay_workload(engine: Any, workload: dict, *, speed: float = 1.0,
         raise ValueError(f"speed must be > 0, got {speed}")
     records = sorted(records, key=lambda r: r.get("t", 0.0))
     playable = [r for r in records if r.get("prompt_tokens")]
+    goodput = getattr(engine, "goodput", None)
+    if goodput is not None and getattr(goodput, "enabled", False):
+        # a clean meter for this replay: the report compares the
+        # replay's OWN waste breakdown against the capture's
+        goodput.reset()
     if not getattr(engine, "_running", False):
         engine.start()
 
@@ -219,6 +257,9 @@ def replay_workload(engine: Any, workload: dict, *, speed: float = 1.0,
                 tpots.append((end - req.first_token_at) * 1000.0
                              / (n - 1))
     slo = getattr(engine, "slo", None)
+    recorded_goodput = header.get("goodput")
+    replayed_goodput = goodput.summary() if goodput is not None \
+        and getattr(goodput, "enabled", False) else None
     return {
         "requests": len(records),
         "submitted": len(pairs),
@@ -233,6 +274,12 @@ def replay_workload(engine: Any, workload: dict, *, speed: float = 1.0,
         "wall_s": round(wall_s, 3),
         "recorded_latency": rec_lat,
         "replayed_latency": _latency_summary(ttfts, tpots, e2es),
+        # efficiency twin of the token diff: same tokens with a
+        # doubled waste share is still a regression, and it has a name
+        "recorded_goodput": recorded_goodput,
+        "replayed_goodput": replayed_goodput,
+        "efficiency_divergence": efficiency_divergence(
+            recorded_goodput, replayed_goodput),
         "slo": slo.state() if slo is not None else None,
     }
 
@@ -243,4 +290,5 @@ def replay_file(engine: Any, path: str, **kw) -> dict:
 
 
 __all__ = ["parse_workload", "load_workload", "replay_workload",
-           "replay_file", "MAX_DIVERGENCES_REPORTED"]
+           "replay_file", "efficiency_divergence",
+           "MAX_DIVERGENCES_REPORTED"]

@@ -36,11 +36,15 @@ import sys
 
 DEFAULT_THRESHOLD = 0.10
 
-#: metrics where LOWER is a regression (throughput family)
+#: metrics where LOWER is a regression (throughput family, plus the
+#: goodput ratio: a drop means more device-seconds went to waste —
+#: padding/bubbles/preemption/rejected drafts — for the same workload;
+#: the per-cause waste_*_s seconds are reported but never gate, their
+#: absolute values scale with wall time)
 THROUGHPUT_KEYS = ("chat_req_per_s", "chat_tok_per_s",
                    "decode_tok_per_s_fused", "decode_tok_per_s_single",
                    "prefill_tok_per_s_kernel", "prefill_tok_per_s_view",
-                   "prod_tok_per_s", "prod_req_per_s")
+                   "prod_tok_per_s", "prod_req_per_s", "goodput_ratio")
 
 
 def is_latency(key: str) -> bool:
@@ -136,7 +140,8 @@ def self_test() -> int:
     regressions through."""
     base = {"status": "fresh", "platform": "cpu", "host": "h", "ts": 1.0,
             "metrics": {"chat_tok_per_s": 1000.0, "chat_req_per_s": 50.0,
-                        "p50_ttft_ms": 40.0}}
+                        "p50_ttft_ms": 40.0, "goodput_ratio": 0.8,
+                        "waste_padding_s": 1.0}}
 
     def entry(ts, **overrides):
         rec = json.loads(json.dumps(base))
@@ -155,6 +160,12 @@ def self_test() -> int:
          [base, entry(2.0, p50_ttft_ms=46.0)], 1),
         ("15% tokens/s IMPROVEMENT passes",
          [base, entry(2.0, chat_tok_per_s=1150.0)], 0),
+        ("15% goodput-ratio drop fails",
+         [base, entry(2.0, goodput_ratio=0.68)], 1),
+        ("5% goodput-ratio dip within threshold passes",
+         [base, entry(2.0, goodput_ratio=0.77)], 0),
+        ("waste seconds double but never gate",
+         [base, entry(2.0, waste_padding_s=2.0)], 0),
         ("single entry passes vacuously",
          [base], 0),
         ("cached entries never gate",

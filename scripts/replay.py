@@ -75,6 +75,13 @@ def main() -> int:
     if args.report:
         with open(args.report, "w") as f:
             f.write(text + "\n")
+    for div in report.get("efficiency_divergence") or []:
+        # advisory, not an exit condition: replay hardware/config may
+        # legitimately differ — but a doubled waste share is worth a
+        # line even when every token matched
+        print(f"# EFFICIENCY DIVERGED: {div['cause']} waste share "
+              f"{div['recorded_share']:.1%} -> "
+              f"{div['replayed_share']:.1%}", file=sys.stderr)
     if report["divergent"] and not args.no_fail:
         print(f"# DIVERGED: {report['divergent']} request(s)",
               file=sys.stderr)

@@ -342,6 +342,48 @@ def test_e2e_metrics_expose_engine_surface(obs_app):
         assert name in series, name
 
 
+def test_e2e_debug_efficiency_conserves(obs_app):
+    """GET /debug/efficiency serves the goodput classification with
+    the conservation invariant intact, watermarks with timestamps,
+    and the recompile-sentinel state."""
+    status, _, _ = obs_app.request(
+        "POST", "/chat", {"prompt": "efficiency probe",
+                          "max_tokens": 8, "temperature": 0.0})
+    assert status == 201
+    status, body = obs_app.get_json("/debug/efficiency")
+    assert status == 200
+    eff = body["data"]["llm"]
+    gp = eff["goodput"]
+    assert gp["busy_s"] > 0
+    total = gp["useful_s"] + sum(gp["waste_s"].values())
+    # each JSON field is rounded to 6 decimals, so the serialized sum
+    # may be off by a few ulps of the rounding grain; the raw-float
+    # invariant is exact (conservation_error_s, and test_goodput.py)
+    assert abs(total - gp["busy_s"]) < 5e-6, gp
+    assert abs(gp["conservation_error_s"]) < 1e-9, gp
+    assert 0.0 < gp["goodput_ratio"] <= 1.0
+    assert set(gp["waste_s"]) == {"padding", "preempt_recompute",
+                                 "spec_rejected", "bubble"}
+    assert eff["watermarks"]["kv_pages"]["value"] > 0
+    assert "t" in eff["watermarks"]["kv_pages"]
+    assert "recompiles" in eff["recompiles"]
+
+
+def test_e2e_debug_engine_exposes_trace_drops(obs_app):
+    """The bounded span exporter's eviction counter is surfaced in
+    /debug/engine — a truncated trace capture must say so."""
+    status, body = obs_app.get_json("/debug/engine?n=1")
+    assert status == 200
+    traces = body["data"]["traces"]
+    assert traces["dropped_spans"] == 0
+    assert traces["buffered_spans"] >= 1
+    assert traces["max_spans"] == 8192
+    # scrape refreshes the gauge from the exporter
+    _, _, data = obs_app.request("GET", "/metrics",
+                                 port=obs_app.metrics_port)
+    assert "app_traces_dropped_spans 0" in data.decode()
+
+
 def test_e2e_profiler_endpoints(obs_app, tmp_path_factory):
     target = str(tmp_path_factory.mktemp("xprof"))
     status, _, data = obs_app.request("POST", "/debug/profile/start",
