@@ -199,6 +199,9 @@ class Container:
                         buckets=_DATASOURCE_BUCKETS)
         m.new_histogram("app_kv_stats", "kv op time in seconds",
                         buckets=_DATASOURCE_BUCKETS)
+        m.new_histogram("app_nats_kv_stats",
+                        "NATS JetStream KV op time in seconds",
+                        buckets=_DATASOURCE_BUCKETS)
         m.new_histogram("app_redis_stats", "redis op time in seconds",
                         buckets=_DATASOURCE_BUCKETS)
         m.new_histogram("app_file_stats", "file op time in seconds",

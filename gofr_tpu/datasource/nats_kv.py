@@ -77,9 +77,13 @@ class NATSKV(ProviderMixin):
                     f"NATSKV {int(elapsed * 1e6):6d}µs {op} "
                     f"{self.bucket}/{key}")
             if self.metrics is not None:
-                # reference histogram name (nats.go Connect)
+                # reference histogram name (nats.go Connect); seconds,
+                # like every other app_*_stats datasource histogram —
+                # this write was both unregistered (silently dropped)
+                # and in milliseconds until gofrlint's metric-hygiene
+                # rule caught it
                 self.metrics.record_histogram("app_nats_kv_stats",
-                                              elapsed * 1e3,
+                                              elapsed,
                                               type=op.lower())
 
     def _subject(self, key: str) -> str:

@@ -411,7 +411,11 @@ class OAuthProvider:
             if self.logger:
                 self.logger.error(f"JWKS fetch failed: {exc!r}")
         finally:
-            self._refreshing = False
+            # cross-thread flag (background refresh thread vs request
+            # threads taking _refresh_lock): reset under the same lock
+            # that guards the test-and-set in _refresh_if_stale
+            with self._refresh_lock:
+                self._refreshing = False
 
     def _refresh_if_stale(self) -> None:
         if self.jwks_url is None:

@@ -82,6 +82,12 @@ def bert_init(key: jax.Array, config: BertConfig) -> dict:
     }
 
 
+def _b(bias):
+    # explicit [1, 1, D] lift onto [B, S, D] activations: the test
+    # harness runs jax_numpy_rank_promotion='raise'
+    return bias.reshape(1, 1, -1)
+
+
 def bert_encode(params: dict, tokens: jnp.ndarray, config: BertConfig, *,
                 attention_mask: jnp.ndarray | None = None,
                 token_types: jnp.ndarray | None = None
@@ -102,21 +108,21 @@ def bert_encode(params: dict, tokens: jnp.ndarray, config: BertConfig, *,
     lengths = attention_mask.sum(axis=-1).astype(jnp.int32)
 
     def layer_fn(x, lp):
-        qkv = x @ lp["wqkv"] + lp["wqkv_b"]
+        qkv = x @ lp["wqkv"] + _b(lp["wqkv_b"])
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(b, s, c.n_heads, c.head_dim)
         k = k.reshape(b, s, c.n_heads, c.head_dim)
         v = v.reshape(b, s, c.n_heads, c.head_dim)
         attn = xla_attention(q, k, v, causal=False, kv_lengths=lengths)
-        attn = attn.reshape(b, s, c.dim) @ lp["wo"] + lp["wo_b"]
+        attn = attn.reshape(b, s, c.dim) @ lp["wo"] + _b(lp["wo_b"])
         x = layer_norm(x + attn, lp["ln1_w"], lp["ln1_b"], c.norm_eps)
-        h = jax.nn.gelu((x @ lp["w1"] + lp["w1_b"]).astype(jnp.float32))
-        h = h.astype(x.dtype) @ lp["w2"] + lp["w2_b"]
+        h = jax.nn.gelu((x @ lp["w1"] + _b(lp["w1_b"])).astype(jnp.float32))
+        h = h.astype(x.dtype) @ lp["w2"] + _b(lp["w2_b"])
         x = layer_norm(x + h, lp["ln2_w"], lp["ln2_b"], c.norm_eps)
         return x, None
 
     x, _ = jax.lax.scan(layer_fn, x, params["layers"])
-    pooled = jnp.tanh((x[:, 0] @ params["pooler_w"] + params["pooler_b"])
+    pooled = jnp.tanh((x[:, 0] @ params["pooler_w"] + params["pooler_b"][None, :])
                       .astype(jnp.float32)).astype(c.dtype)
     return x, pooled
 

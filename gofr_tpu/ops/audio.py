@@ -76,7 +76,9 @@ def log_mel_spectrogram(audio: jnp.ndarray, *, n_mels: int = 80,
            + jnp.arange(n_fft)[None, :])          # [frames, n_fft]
     frames = audio[:, idx]                          # [B, frames, n_fft]
     window = jnp.hanning(n_fft + 1)[:-1].astype(jnp.float32)
-    spectrum = jnp.fft.rfft(frames * window, n=n_fft, axis=-1)
+    # explicit lift to frames' rank (rank_promotion='raise' under test)
+    spectrum = jnp.fft.rfft(frames * window[None, None, :], n=n_fft,
+                            axis=-1)
     power = jnp.abs(spectrum) ** 2                  # [B, frames, n_fft//2+1]
 
     bank = jnp.asarray(mel_filterbank(n_mels, n_fft, sample_rate))

@@ -11,13 +11,20 @@ import jax.lax
 import jax.numpy as jnp
 
 
+def _row(v: jnp.ndarray, ndim: int) -> jnp.ndarray:
+    """Explicitly lift a rank-1 per-channel vector to ``ndim`` for the
+    trailing axis — the tests run with jax_numpy_rank_promotion='raise',
+    so implicit (B, S, D) op (D,) broadcasting is an error."""
+    return v.reshape((1,) * (ndim - 1) + (-1,))
+
+
 def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
     """RMSNorm (Llama-family): x * w / rms(x), stats in f32."""
     dtype = x.dtype
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     normed = xf * jax.lax.rsqrt(var + eps)
-    return (normed * weight.astype(jnp.float32)).astype(dtype)
+    return (normed * _row(weight.astype(jnp.float32), normed.ndim)).astype(dtype)
 
 
 def layer_norm(x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray,
@@ -28,5 +35,6 @@ def layer_norm(x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray,
     mean = jnp.mean(xf, axis=-1, keepdims=True)
     var = jnp.var(xf, axis=-1, keepdims=True)
     normed = (xf - mean) * (var + eps) ** -0.5
-    out = normed * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+    out = (normed * _row(weight.astype(jnp.float32), normed.ndim)
+           + _row(bias.astype(jnp.float32), normed.ndim))
     return out.astype(dtype)

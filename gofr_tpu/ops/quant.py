@@ -76,6 +76,13 @@ def is_quantized(w: Any) -> bool:
     return isinstance(w, dict) and "q" in w and "s" in w
 
 
+def _lift(s: jnp.ndarray, ndim: int) -> jnp.ndarray:
+    """Explicitly pad a scale's leading rank to ``ndim`` — the test
+    harness runs jax_numpy_rank_promotion='raise', so the post-matmul
+    ``y * s`` broadcast must not rely on implicit promotion."""
+    return s.reshape((1,) * (ndim - s.ndim) + s.shape)
+
+
 def qmatmul(x: jnp.ndarray, w: Any, *,
             out_dtype: Any = None) -> jnp.ndarray:
     """x @ w for plain or quantized ``w`` (scale applied post-matmul)."""
@@ -84,7 +91,7 @@ def qmatmul(x: jnp.ndarray, w: Any, *,
                           preferred_element_type=out_dtype or x.dtype)
     y = jnp.matmul(x, w["q"].astype(x.dtype),
                    preferred_element_type=out_dtype or x.dtype)
-    return y * w["s"].astype(y.dtype)
+    return y * _lift(w["s"].astype(y.dtype), y.ndim)
 
 
 def qgather(w: Any, idx: jnp.ndarray, dtype: Any) -> jnp.ndarray:
@@ -104,7 +111,7 @@ def qmatmul_t(x: jnp.ndarray, w: Any, *, out_dtype: Any = None) -> jnp.ndarray:
                           preferred_element_type=out_dtype or x.dtype)
     y = jnp.matmul(x, w["q"].T.astype(x.dtype),
                    preferred_element_type=out_dtype or x.dtype)
-    return y * w["s"].reshape(-1).astype(y.dtype)
+    return y * _lift(w["s"].reshape(-1).astype(y.dtype), y.ndim)
 
 
 #: the 4-bit dtypes XLA packs two-per-byte on TPU

@@ -41,7 +41,10 @@ def apply_rope(x: jnp.ndarray, positions: jnp.ndarray,
     ``positions`` is [..., seq] (absolute token positions, so paged /
     continued decode just passes the running offset).
     """
-    angles = positions[..., :, None].astype(jnp.float32) * inv_freq  # [..., S, D/2]
+    # explicit lift of inv_freq [D/2] to positions' rank + 1: the test
+    # harness runs jax_numpy_rank_promotion='raise'
+    pos = positions[..., :, None].astype(jnp.float32)
+    angles = pos * inv_freq.reshape((1,) * (pos.ndim - 1) + (-1,))  # [..., S, D/2]
     cos = jnp.cos(angles)[..., :, None, :]  # broadcast over heads
     sin = jnp.sin(angles)[..., :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)

@@ -224,7 +224,7 @@ class KafkaClient:
             try:
                 self._reader, self._writer = await asyncio.open_connection(
                     host, int(port or 9092))
-                self._connected = True
+                self._connected = True  # gofrlint: allow(lock-discipline) -- asyncio single-thread: flag flip is atomic between awaits; _connect_lock guards the redial sequence, not the bool
                 if self.logger is not None:
                     self.logger.info(f"Kafka connected {broker}")
                 return
@@ -278,7 +278,7 @@ class KafkaClient:
         r = _Reader(payload)
         got = r.i32()
         if got != corr:
-            self._connected = False
+            self._connected = False  # gofrlint: allow(lock-discipline) -- asyncio single-thread: poison-the-connection flag flip, atomic between awaits
             raise KafkaError(-1, f"correlation mismatch {got} != {corr}")
         return r
 
@@ -550,7 +550,7 @@ class KafkaClient:
                 await self._writer.wait_closed()
             except (ConnectionError, OSError):
                 pass
-        self._connected = False
+        self._connected = False  # gofrlint: allow(lock-discipline) -- asyncio single-thread: close() runs on the loop; no concurrent writer to race
 
 
 # ------------------------------------------------------------ mini broker

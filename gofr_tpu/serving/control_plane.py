@@ -417,8 +417,12 @@ class ControlPlaneLeader:
         med = statistics.median(p95s.values()) if len(p95s) >= 2 else 0.0
         stragglers = sorted(h for h, v in p95s.items()
                             if med > 0 and v > threshold * med)
-        new = set(stragglers) - self._stragglers
-        self._stragglers = set(stragglers)
+        # _stragglers is also mutated by the leave/evict path under
+        # _lock from HTTP handler threads; an unlocked read-modify-write
+        # here (sweeper thread) can race a concurrent discard
+        with self._lock:
+            new = set(stragglers) - self._stragglers
+            self._stragglers = set(stragglers)
         ratio = len(stragglers) / world if world else 0.0
         fleet_goodput: dict = {}
         if goodputs:

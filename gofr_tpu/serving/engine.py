@@ -50,6 +50,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.annotations import hot_path, hot_path_boundary
+
 NEG_INF = -1e30
 
 
@@ -1328,11 +1330,11 @@ class Engine:
         sampled token and open the slot for decode."""
         self._sched_dirty = True  # slot flips pending -> decoding
         req.pending_prefill = False
-        now = time.time()
+        now = time.time()  # gofrlint: allow(hot-path-purity) -- first-token boundary of a finished walk: once per request lifetime
         if req.first_token_at is None:  # not a preemption recompute
             req.first_token_at = now
             if self.metrics is not None:
-                self.metrics.record_histogram(
+                self.metrics.record_histogram(  # gofrlint: allow(hot-path-purity) -- TTFT observation at the walk's collect boundary, once per request lifetime
                     "app_chat_ttft_seconds", now - req.submitted_at,
                     exemplar_trace_id=req.trace[0] if req.trace else None)
         req.generated.append(first)
@@ -1342,6 +1344,7 @@ class Engine:
         if self._finished(req, first):
             self._retire(req.slot)
 
+    @hot_path
     def _walk_chunks(self, pairs: list) -> None:
         """Admit (or resume) prompts through the chunk-with-history
         walk — prompts longer than the widest bucket, prefix-cache
@@ -1469,7 +1472,7 @@ class Engine:
                         self._note_dispatch_shape("chunk", width, G, cw)
                         c0 = time.perf_counter()
                         self.goodput.note_dispatch(c0)
-                        w0 = time.time()
+                        w0 = time.time()  # gofrlint: allow(hot-path-purity) -- span timestamps use wall clock; once per chunk dispatch (the walk is synchronous by design)
                         toks, self.k_cache, self.v_cache = call(
                             self.params, jnp.asarray(tokens),
                             self.k_cache, self.v_cache,
@@ -1497,7 +1500,7 @@ class Engine:
                         self.goodput.add_prefill(
                             "prefill_chunk", c_dur, G,
                             len(ready) - recomp, recomp)
-                        w1 = time.time()
+                        w1 = time.time()  # gofrlint: allow(hot-path-purity) -- span timestamps use wall clock; once per chunk dispatch
                         for r in ready:
                             r.device_s += c_dur / len(ready)
                             if r.first_token_at is not None:
@@ -1512,7 +1515,7 @@ class Engine:
                             r.prefill_offset += int(lens[row])
                             if r.prefill_offset >= len(r.prompt_tokens):
                                 if toks_np is None:
-                                    toks_np = np.asarray(toks)
+                                    toks_np = np.asarray(toks)  # gofrlint: allow(hot-path-purity) -- this sync IS the walk's collect: finished walkers' first tokens cross to host here
                                 self._finish_walk(r, int(toks_np[row]))
                         dispatched = []
         except Exception as exc:
@@ -1528,7 +1531,7 @@ class Engine:
                 r.pending_prefill = False
                 self._fail(r, str(exc))
             if self.logger:
-                self.logger.error(f"chunked prefill failed: {exc!r}")
+                self.logger.error(f"chunked prefill failed: {exc!r}")  # gofrlint: allow(hot-path-purity) -- failure path: the chunk dispatch raised; rows are being failed, not served
             self._recover_lost_cache(exc)
         self._note_prefill_span(start)
         self._update_kv_watermarks()
@@ -1551,6 +1554,8 @@ class Engine:
             self._page_refs[page] = 0
             self._free_pages.append(page)
 
+    @hot_path_boundary(
+        "pool-pressure eviction event; runs only after an allocation already missed")
     def _evict_prefix_entries(self, pages_needed: int) -> None:
         """Drop LRU prefix-cache entries (insertion order IS the LRU
         order — touches reinsert) until the free list can cover
@@ -1659,6 +1664,8 @@ class Engine:
         self._prefix_lens[aligned] = self._prefix_lens.get(aligned, 0) + 1
         self._cached_pages += n
 
+    @hot_path_boundary(
+        "event-driven eviction; its host work is amortized over the recompute prefill it schedules, not paid per pass")
     def _preempt(self, slot: int) -> None:
         """Evict a request, keeping its stream open: pages return to
         the pool now, the request re-enters the queue with prompt =
@@ -1724,6 +1731,8 @@ class Engine:
                 victims, key=lambda i: self.active[i].admit_order))
         return True
 
+    @hot_path_boundary(
+        "event-driven backpressure bookkeeping (admission races, pool pressure), not steady-state")
     def _requeue(self, req: GenRequest) -> None:
         if id(req) not in self._requeued_set:
             self._requeued_set.add(id(req))
@@ -1754,6 +1763,8 @@ class Engine:
         kc, vc = self._make_cache(self._n_pages, page)
         return pool_from_cache_shape(kc), pool_from_cache_shape(vc)
 
+    @hot_path_boundary(
+        "device-loss recovery path: the engine is already off the fast path when this runs")
     def _recover_lost_cache(self, exc: BaseException) -> None:
         """A failed prefill may have consumed the donated caches; if
         so every active slot's KV went with them — fail those streams
@@ -1784,6 +1795,8 @@ class Engine:
             self.k_cache, self.v_cache = self._make_cache(
                 cfg.max_batch, cfg.max_seq)
 
+    @hot_path_boundary(
+        "O(1) host set probe per dispatch; the metric/log fire only on an anomalous post-warmup recompile")
     def _note_dispatch_shape(self, *sig: Any) -> None:
         """Recompile-sentinel hook at every device dispatch site: a
         novel post-warmup shape signature means XLA is lowering a new
@@ -1822,6 +1835,8 @@ class Engine:
         if len(req.events) < 64:
             req.events.append((name, t0, t1, attrs or {}))
 
+    @hot_path_boundary(
+        "admission boundary: closes the queue-wait span exactly once per request")
     def _note_admitted(self, req: GenRequest) -> None:
         """First slot assignment: the queue span ends here. Recompute
         re-admissions (preemption, pool-exhaustion restarts) keep the
@@ -1887,6 +1902,8 @@ class Engine:
             except Exception:  # tracing must never take down a stream
                 pass
 
+    @hot_path_boundary(
+        "terminal error path; observability assembly mirrors _retire")
     def _fail(self, req: GenRequest, error: str) -> None:
         req.error = error
         req.finished_at = time.time()
@@ -1969,6 +1986,7 @@ class Engine:
         if self._pending_prefills and self._pipeline_depth() == 0:
             self._collect_prefills()
 
+    @hot_path
     def _prefill_group(self, bucket: int, chunk: list[GenRequest]) -> None:
         cfg = self.config
         paged = cfg.kv_layout == "paged"
@@ -2042,7 +2060,7 @@ class Engine:
                     self._release_pages(req.slot)
                 self._fail(req, str(exc))
             if self.logger:
-                self.logger.error(f"prefill failed: {exc!r}")
+                self.logger.error(f"prefill failed: {exc!r}")  # gofrlint: allow(hot-path-purity) -- failure path: the prefill already raised; the engine is off the fast path
             self._recover_lost_cache(exc)
             return
 
@@ -2060,10 +2078,11 @@ class Engine:
             "slots": [r.slot for r in placed],
             "epochs": [r.prefill_epoch for r in placed],
             "t0": start,
-            "wall0": time.time(),  # span timestamps use wall clock
+            "wall0": time.time(),  # span timestamps use wall clock  # gofrlint: allow(hot-path-purity) -- span timestamps use wall clock; once per prefill dispatch, never per decode pass
             "bucket": bucket,
         })
 
+    @hot_path
     def _collect_prefills(self) -> None:
         """Sync dispatched batch prefills: emit first tokens, open the
         slots for decode. Requests whose slot changed hands or that
@@ -2075,7 +2094,7 @@ class Engine:
         while self._pending_prefills:
             rec = self._pending_prefills.popleft()
             try:
-                toks_np = np.asarray(rec["toks"])
+                toks_np = np.asarray(rec["toks"])  # gofrlint: allow(hot-path-purity) -- this sync IS the prefill collect: first tokens cross to host here by design
             except Exception as exc:
                 for req, slot, epoch in zip(rec["placed"], rec["slots"],
                                             rec["epochs"]):
@@ -2089,11 +2108,11 @@ class Engine:
                     if req.finished_at is None:
                         self._fail(req, str(exc))
                 if self.logger:
-                    self.logger.error(f"prefill failed: {exc!r}")
+                    self.logger.error(f"prefill failed: {exc!r}")  # gofrlint: allow(hot-path-purity) -- failure path: device collect raised; slots are being failed, not served
                 self._recover_lost_cache(exc)
                 continue
             self._note_prefill_span(rec["t0"])
-            now = time.time()
+            now = time.time()  # gofrlint: allow(hot-path-purity) -- wall-clock span assembly at the prefill collect boundary, once per batch
             pass_dur = time.perf_counter() - rec["t0"]
             pass_share = pass_dur / max(1, len(rec["placed"]))
             if self.recorder.enabled:
@@ -2129,7 +2148,7 @@ class Engine:
                 if req.first_token_at is None:  # not a recompute
                     req.first_token_at = now
                     if self.metrics is not None:
-                        self.metrics.record_histogram(
+                        self.metrics.record_histogram(  # gofrlint: allow(hot-path-purity) -- TTFT observation at the collect boundary, once per request lifetime
                             "app_chat_ttft_seconds",
                             now - req.submitted_at,
                             exemplar_trace_id=req.trace[0]
@@ -2208,6 +2227,8 @@ class Engine:
             return True
         return len(req.generated) >= req.params.max_new_tokens
 
+    @hot_path_boundary(
+        "terminal per-request path: host-side span/metric/ledger assembly at retire is the architecture (PRs 3-5); runs once per request, never per pass")
     def _retire(self, slot: int) -> None:
         req = self.active[slot]
         if req is None:
@@ -2254,6 +2275,7 @@ class Engine:
                        if r is not None and not r.pending_prefill)
         return 1 if decoding >= cfg.pipeline_min_slots else 0
 
+    @hot_path
     def _decode_step(self) -> None:
         before = len(self._pending)
         self._decode_dispatch()
@@ -2266,10 +2288,12 @@ class Engine:
             while len(self._pending) > depth:
                 self._decode_collect()
 
+    @hot_path
     def _drain_pending(self) -> None:
         while self._pending:
             self._decode_collect()
 
+    @hot_path
     def _sync_decode_state(self) -> None:
         """Rebuild + upload the per-slot scheduler arrays the decode
         graph consumes. Called ONLY when an event (admission, retire,
@@ -2324,8 +2348,9 @@ class Engine:
         self.stats["sched_syncs"] += 1
         self.stats["h2d_transfers"] += 7
         if self.metrics is not None:
-            self.metrics.add_counter("app_engine_h2d_transfers", 7.0)
+            self.metrics.add_counter("app_engine_h2d_transfers", 7.0)  # gofrlint: allow(hot-path-purity) -- event-driven sched sync: this write records the h2d-invariant counter (zero per steady-state pass)
 
+    @hot_path
     def _tables_arg(self):
         """Device-resident block tables, re-uploaded only when the
         host tables changed (page alloc/free/prefix attach) — page
@@ -2336,9 +2361,10 @@ class Engine:
             self._tables_dirty = False
             self.stats["h2d_transfers"] += 1
             if self.metrics is not None:
-                self.metrics.add_counter("app_engine_h2d_transfers", 1.0)
+                self.metrics.add_counter("app_engine_h2d_transfers", 1.0)  # gofrlint: allow(hot-path-purity) -- event-driven table upload: page growth, not steady state; the write records the h2d invariant
         return self._dev_tables
 
+    @hot_path
     def _decode_dispatch(self) -> None:
         cfg = self.config
         T = self._tokens_per_pass
@@ -2427,6 +2453,7 @@ class Engine:
         })
         self.stats["dispatch_s"] += disp
 
+    @hot_path
     def _decode_collect(self) -> None:
         """Sync the oldest in-flight pass: emit its tokens, retire
         finished slots.  Slots whose request was retired or preempted
@@ -2434,7 +2461,7 @@ class Engine:
         if not self._pending:
             return
         rec = self._pending.popleft()
-        step_np = np.asarray(rec["toks"])  # [T, B] — blocks on device
+        step_np = np.asarray(rec["toks"])  # [T, B] — blocks on device  # gofrlint: allow(hot-path-purity) -- this sync IS the decode collect: the token download is the pass's one sanctioned device read
         # decode_s = wall time with a decode pass in flight (dispatch →
         # sync complete), accumulated as a UNION of spans — consecutive
         # passes overlap (N+1 dispatches before N collects), and host/
@@ -2447,8 +2474,8 @@ class Engine:
         self.stats["decode_s"] += busy
         occupancy = int(rec["mask"].sum())
         if self.metrics is not None:
-            self.metrics.record_histogram("app_tpu_execute_seconds", busy)
-            self.metrics.record_histogram("app_engine_batch_occupancy",
+            self.metrics.record_histogram("app_tpu_execute_seconds", busy)  # gofrlint: allow(hot-path-purity) -- per-pass observation at the collect sync point, host floats already paid for
+            self.metrics.record_histogram("app_engine_batch_occupancy",  # gofrlint: allow(hot-path-purity) -- per-pass observation at the collect sync point, host floats already paid for
                                           float(occupancy))
         self._step_count += 1
         # KV watermark BEFORE retires zero the finishing slots: the

@@ -1,0 +1,14 @@
+"""blocking-in-async: violations."""
+import time
+import subprocess
+import requests
+import urllib.request
+
+
+async def agent_tick():
+    time.sleep(0.5)                               # L9: blocks the loop
+    requests.get("http://example.com/health")     # L10: sync HTTP
+    urllib.request.urlopen("http://example.com")  # L11: sync HTTP
+    subprocess.run(["true"])                      # L12: subprocess wait
+    with open("/tmp/state.json") as f:            # L13: sync file IO
+        return f.read()

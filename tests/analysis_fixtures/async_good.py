@@ -1,0 +1,24 @@
+"""blocking-in-async: clean twin."""
+import asyncio
+import time
+
+
+async def agent_tick(client):
+    await asyncio.sleep(0.5)          # the async way
+    t = time.perf_counter()           # timers are fine
+    await client.get("/health")       # async HTTP client
+
+    def offload():
+        # nested SYNC def: runs in an executor, allowed to block
+        time.sleep(0.1)
+        with open("/tmp/state.json") as f:
+            return f.read()
+
+    return await asyncio.to_thread(offload), t
+
+
+def plain_sync():
+    # not async: blocking is its job
+    time.sleep(0.01)
+    with open("/tmp/state.json") as f:
+        return f.read()

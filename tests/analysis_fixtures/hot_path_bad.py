@@ -1,0 +1,32 @@
+"""hot-path-purity: violations. Lines matter — test_analysis.py pins them."""
+import time
+import datetime
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from gofr_tpu.analysis import hot_path
+
+
+class Engine:
+    @hot_path
+    def dispatch(self, state, logits):
+        t0 = time.time()                      # L14: wall clock
+        host = np.asarray(state)              # L15: d2h sync
+        n = int(jnp.argmax(logits))           # L16: coerce traced value
+        logits.block_until_ready()            # L17: device sync
+        jax.device_get(state)                 # L18: device sync
+        v = state.item()                      # L19: device sync
+        self.metrics.increment_counter("app_x")   # L20: metric write
+        self.logger.info("dispatched")        # L21: logging
+        when = datetime.datetime.now()        # L22: wall clock
+        return host, n, v, t0, when
+
+    @hot_path
+    def step(self):
+        return self._helper()
+
+    def _helper(self):
+        # not decorated, but statically called from a @hot_path root:
+        # the closure walk must still reach it
+        return time.time()                    # L32: wall clock via closure

@@ -1,0 +1,29 @@
+"""hot-path-purity: the clean twin — none of this may be flagged."""
+import time
+import numpy as np
+import jax.numpy as jnp
+
+from gofr_tpu.analysis import hot_path, hot_path_boundary
+
+
+class Engine:
+    @hot_path
+    def dispatch(self, state, logits):
+        t0 = time.perf_counter()        # sanctioned timer
+        staged = jnp.asarray(state)     # h2d upload, stays on device
+        buf = np.zeros(4, np.int32)     # host alloc, no device involved
+        n = int(buf[0])                 # coerces a HOST value: legal
+        self._retire(n)                 # boundary: walk stops there
+        return staged, t0
+
+    @hot_path_boundary("terminal path: host assembly at retire is the design")
+    def _retire(self, n):
+        # inside a boundary anything goes — this is the point of it
+        self.metrics.increment_counter("app_engine_retires")
+        self.logger.info("retired", n=n)
+        return time.time()
+
+    def cold_path(self):
+        # undecorated and unreachable from a @hot_path root: not scanned
+        self.metrics.increment_counter("app_cold")
+        return time.time(), np.asarray([1])

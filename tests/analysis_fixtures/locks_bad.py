@@ -1,0 +1,23 @@
+"""lock-discipline: violations."""
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []        # __init__ is exempt AND establishes nothing
+        self._count = 0
+
+    def put(self, x):
+        with self._lock:
+            self._items.append(x)     # establishes: _items is protected
+            self._count += 1          # establishes: _count is protected
+
+    def racy_put(self, x):
+        self._items.append(x)         # L17: unlocked .append() mutation
+
+    def racy_reset(self):
+        self._count = 0               # L20: unlocked assignment
+
+    def racy_pop(self):
+        return self._items.pop()      # L23: unlocked .pop() mutation

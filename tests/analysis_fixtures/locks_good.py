@@ -1,0 +1,38 @@
+"""lock-discipline: clean twin — locked writes, _locked helpers, and
+attributes that were never lock-protected to begin with."""
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._count = 0
+        self._name = "pool"     # never touched under a lock anywhere
+
+    def put(self, x):
+        with self._lock:
+            self._items.append(x)
+            self._count += 1
+
+    def drain(self):
+        with self._lock:
+            self._evict_locked()
+
+    def _evict_locked(self):
+        # *_locked naming convention: caller holds the lock
+        self._items.clear()
+        self._count = 0
+
+    def rename(self, name):
+        # _name has no locked mutation anywhere -> not in the lockset
+        self._name = name
+
+
+class Unlocked:
+    # a class with no lock at all is never flagged
+    def __init__(self):
+        self.state = 0
+
+    def bump(self):
+        self.state += 1

@@ -1,0 +1,14 @@
+"""metric-hygiene: violations (unregistered write, orphan registration,
+dynamic name)."""
+
+
+def setup(metrics):
+    metrics.new_counter("app_orphan_total",         # L5: registered, never written
+                        "no write anywhere")
+    metrics.new_gauge("app_used_gauge", "written below")
+
+
+def serve(metrics, name):
+    metrics.set_gauge("app_used_gauge", 1.0)
+    metrics.increment_counter("app_never_registered")   # L12: write w/o registration
+    metrics.record_histogram(name, 0.5)                 # L13: dynamic name

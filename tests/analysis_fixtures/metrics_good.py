@@ -1,0 +1,24 @@
+"""metric-hygiene: clean twin — literal pairs, plus the registration-loop
+idiom the analyzer unrolls statically."""
+
+_GAUGES = (
+    ("app_fixture_occupancy", "slots in use"),
+    ("app_fixture_queue_depth", "requests waiting"),
+)
+
+
+def setup(metrics):
+    metrics.new_counter("app_fixture_requests", "requests served")
+    for name, desc in _GAUGES:
+        metrics.new_gauge(name, desc)
+    for name, desc in (
+        ("app_fixture_ttft_seconds", "time to first token"),
+    ):
+        metrics.new_histogram(name, desc)
+
+
+def serve(metrics):
+    metrics.increment_counter("app_fixture_requests")
+    metrics.set_gauge("app_fixture_occupancy", 3.0)
+    metrics.set_gauge("app_fixture_queue_depth", 0.0)
+    metrics.record_histogram("app_fixture_ttft_seconds", 0.03)

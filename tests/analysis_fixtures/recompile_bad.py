@@ -1,0 +1,29 @@
+"""recompile-hazard: violations — per-request values into static args."""
+import functools
+
+import jax
+
+
+def forward(tokens, width):
+    return tokens
+
+
+_jitted = jax.jit(forward, static_argnums=(1,))
+_named = jax.jit(forward, static_argnames=("width",))
+_partial = functools.partial(jax.jit, static_argnums=(1,))(forward)
+
+
+def serve(req):
+    out = _jitted(req.tokens, len(req.prompt_tokens))   # L17: tainted position
+    out = _named(req.tokens, width=req.width)           # L18: tainted kwarg
+    out = _partial(req.tokens, len(req.tokens))         # L19: tainted via partial
+    return out
+
+
+class Engine:
+    def build(self):
+        self._fwd = jax.jit(forward, static_argnums=(1,))
+
+    def step(self, request):
+        # attribute-held wrapper, len() is taint-transparent
+        return self._fwd(request.tokens, len(request.tokens))   # L29

@@ -1,0 +1,29 @@
+"""recompile-hazard: clean twin — bucketing breaks the taint; constants
+and config-derived statics are fine."""
+import jax
+
+
+def forward(tokens, width):
+    return tokens
+
+
+_jitted = jax.jit(forward, static_argnums=(1,))
+_plain = jax.jit(forward)   # no statics: never a hazard source
+
+
+def _bucket_for(n):
+    b = 16
+    while b < n:
+        b *= 2
+    return b
+
+
+def serve(req, config):
+    # routed through the bucketing helper: sanctioned
+    out = _jitted(req.tokens, _bucket_for(len(req.tokens)))
+    # config-derived static: compiles once per deployment, not per request
+    out = _jitted(req.tokens, config.max_seq)
+    # literal static
+    out = _jitted(req.tokens, 128)
+    # no statics involved at all
+    return _plain(out)

@@ -1,0 +1,17 @@
+"""bad-suppression: a reason-less allow, a stale allow, a typo'd rule."""
+import time
+
+from gofr_tpu.analysis import hot_path
+
+
+@hot_path
+def dispatch():
+    return time.time()  # gofrlint: allow(hot-path-purity)
+
+# stale — nothing on this line violates anything
+x = 1  # gofrlint: allow(lock-discipline) -- guards a finding that is not here
+
+
+@hot_path
+def dispatch2():
+    return time.time()  # gofrlint: allow(hot-path-purty) -- typo'd rule id covers nothing

@@ -1,0 +1,14 @@
+"""Valid suppressions: reasoned allow on the finding line silences it."""
+import time
+
+from gofr_tpu.analysis import hot_path
+
+
+@hot_path
+def dispatch():
+    return time.time()  # gofrlint: allow(hot-path-purity) -- fixture: wall clock here is the test's point
+
+
+@hot_path
+def dispatch_multi(metrics):
+    metrics.set_gauge("app_fixture_g", time.time())  # gofrlint: allow(hot-path-purity, metric-hygiene) -- fixture: one allow may cover several rules

@@ -28,3 +28,15 @@ os.environ["JAX_PLATFORMS"] = _platform
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", _platform)
+
+# Silent rank promotion ((B,) op (B, N) broadcasting by accident) is a
+# classic source of wrong-but-plausible numerics in ops/models — make
+# it a hard error under test. Production code is unaffected; this is a
+# test-harness invariant, the static sibling of gofrlint's rules.
+jax.config.update("jax_numpy_rank_promotion", "raise")
+
+# Opt-in NaN tripwire: GOFR_DEBUG_NANS=1 makes every jitted op re-run
+# eagerly and raise at the op that produced a NaN (jax_debug_nans) —
+# too slow for CI default, invaluable when hunting a numeric bug.
+if os.environ.get("GOFR_DEBUG_NANS", "").lower() in ("1", "true", "yes"):
+    jax.config.update("jax_debug_nans", True)

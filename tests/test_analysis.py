@@ -1,0 +1,226 @@
+"""gofrlint unit tests: per-rule fixtures (flagged + clean twins),
+suppression parsing, the CLI contract, and the meta-test pinning the
+static metric extraction to the dynamic registry-coverage scan on the
+live repo."""
+
+import json
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from gofr_tpu.analysis import run_analysis
+from gofr_tpu.analysis.rules import metric_hygiene
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "analysis_fixtures"
+
+
+def lint(*names, rules=None):
+    findings, _ = run_analysis([FIXTURES / n for n in names],
+                               rules=rules, root=REPO)
+    return findings
+
+
+def violations(findings, rule=None):
+    out = [f for f in findings if not f.suppressed]
+    if rule is not None:
+        out = [f for f in out if f.rule == rule]
+    return out
+
+
+# ------------------------------------------------------------ hot path
+class TestHotPathPurity:
+    def test_bad_fixture_flags_every_seeded_violation(self):
+        got = violations(lint("hot_path_bad.py"), "hot-path-purity")
+        lines = {f.line for f in got}
+        # the nine direct violations in dispatch() ...
+        assert {14, 15, 16, 17, 18, 19, 20, 21, 22} <= lines
+        # ... and the closure-reached one in the undecorated helper
+        assert 32 in lines
+
+    def test_closure_finding_names_the_root_chain(self):
+        got = violations(lint("hot_path_bad.py"), "hot-path-purity")
+        via = [f for f in got if f.line == 32]
+        assert via and "Engine.step" in via[0].message
+
+    def test_clean_twin_is_silent(self):
+        assert violations(lint("hot_path_good.py"), "hot-path-purity") == []
+
+    def test_boundary_stops_traversal_but_cold_code_is_ignored(self):
+        # _retire (boundary) and cold_path (unreachable) both contain
+        # would-be violations; neither may fire
+        got = lint("hot_path_good.py")
+        assert violations(got, "hot-path-purity") == []
+
+
+# ---------------------------------------------------------------- locks
+class TestLockDiscipline:
+    def test_bad_fixture(self):
+        got = violations(lint("locks_bad.py"), "lock-discipline")
+        assert {f.line for f in got} == {17, 20, 23}
+        assert any("_items" in f.message for f in got)
+        assert any("_count" in f.message for f in got)
+
+    def test_clean_twin(self):
+        assert violations(lint("locks_good.py"), "lock-discipline") == []
+
+
+# ---------------------------------------------------------------- async
+class TestBlockingInAsync:
+    def test_bad_fixture(self):
+        got = violations(lint("async_bad.py"), "blocking-in-async")
+        assert {f.line for f in got} == {9, 10, 11, 12, 13}
+
+    def test_clean_twin(self):
+        assert violations(lint("async_good.py"), "blocking-in-async") == []
+
+
+# -------------------------------------------------------------- metrics
+class TestMetricHygiene:
+    def test_bad_fixture(self):
+        got = violations(lint("metrics_bad.py"), "metric-hygiene")
+        msgs = {f.line: f.message for f in got}
+        assert "app_orphan_total" in msgs[6]      # orphan registration
+        assert "app_never_registered" in msgs[13]
+        assert "not a string literal" in msgs[14]
+        assert len(got) == 3
+
+    def test_clean_twin_including_loop_unroll(self):
+        assert violations(lint("metrics_good.py"), "metric-hygiene") == []
+
+    def test_cross_file_resolution(self):
+        # registration in one file, write in the other: both clean when
+        # linted together
+        got = violations(lint("metrics_good.py", "metrics_bad.py"),
+                         "metric-hygiene")
+        # bad file's findings survive; good file contributes none
+        assert all(f.path.endswith("metrics_bad.py") for f in got)
+
+
+# ------------------------------------------------------------ recompile
+class TestRecompileHazard:
+    def test_bad_fixture(self):
+        got = violations(lint("recompile_bad.py"), "recompile-hazard")
+        assert {f.line for f in got} == {17, 18, 19, 29}
+
+    def test_clean_twin(self):
+        assert violations(lint("recompile_good.py"), "recompile-hazard") == []
+
+
+# ---------------------------------------------------------- suppression
+class TestSuppressions:
+    def test_missing_reason_is_an_error(self):
+        got = lint("suppression_bad.py")
+        bad = violations(got, "bad-suppression")
+        assert any("missing its mandatory" in f.message and f.line == 9
+                   for f in bad)
+
+    def test_reasonless_allow_does_not_suppress(self):
+        got = lint("suppression_bad.py")
+        assert any(f.line == 9 for f in
+                   violations(got, "hot-path-purity"))
+
+    def test_stale_allow_is_an_error(self):
+        got = lint("suppression_bad.py")
+        assert any(f.line == 12 and "suppresses nothing" in f.message
+                   for f in violations(got, "bad-suppression"))
+
+    def test_typoed_rule_neither_suppresses_nor_passes(self):
+        got = lint("suppression_bad.py")
+        assert any(f.line == 17 for f in violations(got, "hot-path-purity"))
+        assert any(f.line == 17 for f in violations(got, "bad-suppression"))
+
+    def test_valid_allow_suppresses_and_keeps_reason(self):
+        got = lint("suppression_good.py")
+        assert violations(got) == []
+        sup = [f for f in got if f.suppressed]
+        assert sup and all(f.allow_reason for f in sup)
+
+    def test_one_allow_may_cover_multiple_rules(self):
+        got = lint("suppression_good.py")
+        rules = {f.rule for f in got if f.suppressed and f.line == 14}
+        assert "hot-path-purity" in rules
+
+
+# ------------------------------------------------------------------ CLI
+class TestCLI:
+    def run_cli(self, *args):
+        return subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "lint.py"), *args],
+            capture_output=True, text=True, cwd=REPO)
+
+    def test_bad_fixture_exits_nonzero_with_file_line(self):
+        r = self.run_cli(str(FIXTURES / "async_bad.py"))
+        assert r.returncode == 1
+        assert re.search(r"async_bad\.py:9:\d+: \[blocking-in-async\]",
+                         r.stdout)
+
+    def test_json_format_is_machine_readable(self):
+        r = self.run_cli("--format=json", str(FIXTURES / "async_bad.py"))
+        assert r.returncode == 1
+        doc = json.loads(r.stdout)
+        assert doc["counts"]["blocking-in-async"] == 5
+        assert all({"rule", "path", "line", "col", "message"}
+                   <= set(v) for v in doc["violations"])
+
+    def test_clean_fixture_exits_zero(self):
+        r = self.run_cli(str(FIXTURES / "async_good.py"))
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_self_test_passes(self):
+        r = self.run_cli("--self-test")
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_unknown_rule_is_usage_error(self):
+        r = self.run_cli("--rule", "no-such-rule", ".")
+        assert r.returncode == 2
+
+    def test_repo_lints_clean(self):
+        # the acceptance gate itself: the live tree must stay clean
+        r = self.run_cli("gofr_tpu/", "scripts/", "bench.py")
+        assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ------------------------------------------------------------- meta-test
+class TestStaticDynamicAgreement:
+    """gofrlint's static metric extraction and the dynamic
+    registry-coverage test (test_observability.py) must agree on the
+    live repo — if they drift, one of them has a blind spot."""
+
+    def test_static_extraction_covers_the_dynamic_scan(self):
+        from gofr_tpu.analysis.core import load_project
+        from .test_observability import _WRITE_RE, SERVING_DIR
+
+        regex_names = set()
+        for path in SERVING_DIR.glob("*.py"):
+            regex_names.update(_WRITE_RE.findall(path.read_text()))
+
+        project = load_project([SERVING_DIR], root=REPO)
+        static_names = metric_hygiene.written_names(project)
+
+        # everything the regex sees, the AST walk must see ...
+        assert regex_names <= static_names, (
+            f"static extraction missed: {sorted(regex_names - static_names)}")
+        # ... and anything extra the AST walk finds (multi-line calls,
+        # loop-unrolled names the regex can't follow) must still be a
+        # registered metric, or the dynamic test has a blind spot
+        extra = static_names - regex_names
+        whole_tree = load_project([REPO / "gofr_tpu"], root=REPO)
+        registered = metric_hygiene.registered_names(whole_tree)
+        assert extra <= registered, (
+            f"statically-found writes the dynamic test cannot see AND "
+            f"nobody registers: {sorted(extra - registered)}")
+
+    def test_every_serving_write_is_statically_registered(self):
+        """The static twin of the dynamic coverage test's main assert."""
+        from gofr_tpu.analysis.core import load_project
+        serving = load_project([REPO / "gofr_tpu" / "serving"], root=REPO)
+        whole_tree = load_project([REPO / "gofr_tpu"], root=REPO)
+        written = metric_hygiene.written_names(serving)
+        registered = metric_hygiene.registered_names(whole_tree)
+        assert written, "no writes found — the extraction broke"
+        missing = sorted(n for n in written if n not in registered)
+        assert not missing, f"written in serving/ but never registered: {missing}"

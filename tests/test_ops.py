@@ -23,7 +23,10 @@ def test_rms_norm_matches_reference():
     x = jax.random.normal(jax.random.key(0), (2, 5, 64))
     w = jax.random.normal(jax.random.key(1), (64,)) * 0.1 + 1.0
     got = rms_norm(x, w)
-    expected = x / np.sqrt((np.asarray(x) ** 2).mean(-1, keepdims=True) + 1e-6) * np.asarray(w)
+    # pure-numpy reference: mixing the jax x into numpy ops would hit
+    # the harness's jax_numpy_rank_promotion='raise'
+    xn = np.asarray(x)
+    expected = xn / np.sqrt((xn ** 2).mean(-1, keepdims=True) + 1e-6) * np.asarray(w)
     np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-5)
 
 
