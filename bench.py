@@ -504,6 +504,10 @@ def headline_metrics(payload: dict) -> dict:
     put("prod_req_per_s", prod.get("req_per_s"))
     goodput = payload.get("goodput") or {}
     put("goodput_ratio", goodput.get("goodput_ratio"))
+    # busy_s rides along so the compare gate can tell a statistically
+    # meaningful goodput_ratio from same-host CPU-smoke noise (~20 ms
+    # of busy time) — reported, never gated itself
+    put("goodput_busy_s", goodput.get("busy_s"))
     for cause, seconds in (goodput.get("waste_s") or {}).items():
         put(f"waste_{cause}_s", seconds)
     return out

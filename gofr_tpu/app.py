@@ -257,13 +257,18 @@ class App:
 
     def serve_model(self, name: str, engine, tokenizer=None, *,
                     chat_path: str | None = "/chat",
-                    slo=None) -> None:
+                    slo=None, scheduler=None) -> None:
         """Wire a serving engine into the app: metrics, health, lifecycle,
         and (optionally) a chat endpoint, in one call. ``slo`` is an
         optional :class:`~gofr_tpu.serving.observability.SLOConfig`;
         by default the engine gets a tracker with the stock objectives
         (burn-rate gauges + ``GET /debug/slo``); pass a config to tune
-        thresholds, or construct/clear ``engine.slo`` yourself."""
+        thresholds, or construct/clear ``engine.slo`` yourself.
+        ``scheduler`` is an optional
+        :class:`~gofr_tpu.serving.scheduler.SchedulerConfig` swapped
+        into the engine's admission queue (fair-share weights, lanes,
+        rate limits, shedding — see docs/configs.md); the default
+        fair-share policy is already on."""
         if hasattr(engine, "attach_metrics"):
             engine.attach_metrics(self.container.metrics)
         else:
@@ -284,6 +289,15 @@ class App:
             engine.slo = SLOTracker(slo or SLOConfig(),
                                     metrics=self.container.metrics,
                                     logger=self.logger)
+        # scheduler plumbing: the engine constructed its admission
+        # queue already — swap in the app-level policy and wire the
+        # shed-episode WARNs to the app logger
+        sched = getattr(engine, "waiting", None)
+        if sched is not None and hasattr(sched, "reconfigure"):
+            if scheduler is not None:
+                sched.reconfigure(scheduler)
+            if getattr(sched, "logger", None) is None:
+                sched.logger = self.logger
         self.container.add_model(name, engine)
         self._install_debug_routes()
         if self.container.tpu is None:
@@ -452,6 +466,19 @@ class App:
                 out[model_name] = slo.state() if slo is not None else None
             return out
         self.get("/debug/slo", slo_debug)
+
+        def scheduler_debug(ctx):
+            """Admission-scheduler state per served model: policy,
+            lane depths, per-tenant shares/weights/burn, token-bucket
+            levels, shed-episode state and the rejection counters —
+            the overload runbook's first stop (docs/operations.md)."""
+            out = {}
+            for model_name, engine in container.models.items():
+                sched = getattr(engine, "waiting", None)
+                out[model_name] = sched.state() \
+                    if hasattr(sched, "state") else None
+            return out
+        self.get("/debug/scheduler", scheduler_debug)
 
         def pick_workload_recorder(ctx):
             """``?model=`` selects among served models (404 on an

@@ -285,6 +285,23 @@ class Container:
         m.new_gauge("app_slo_error_budget_remaining",
                     "fraction of the availability error budget left "
                     "over SLOConfig.budget_window_s")
+        # admission-scheduler series (serving/scheduler.py): written at
+        # admission rejects / starvation preempts / the throttled gauge
+        # pass — never from the decode hot loop
+        m.new_gauge("app_sched_lane_depth",
+                    "queued requests per scheduler lane "
+                    "(interactive/background)")
+        m.new_gauge("app_sched_tenant_share",
+                    "per-tenant fraction of windowed device time "
+                    "(the fair-share dequeue signal)")
+        m.new_gauge("app_sched_shed_active",
+                    "1 while a burn-rate shed episode is active")
+        m.new_counter("app_sched_rejections",
+                      "admission refusals by cause "
+                      "(queue_full/rate_limited/shed) and tenant")
+        m.new_counter("app_sched_preemptions",
+                      "scheduler-initiated background preemptions to "
+                      "unstarve the interactive lane")
 
     # ------------------------------------------------------------- health
     def health(self) -> dict[str, Any]:

@@ -18,11 +18,15 @@ class HTTPError(Exception):
     log_level: Level = ERROR
 
     def __init__(self, message: str = "", *, status_code: int | None = None,
-                 details: object = None) -> None:
+                 details: object = None,
+                 headers: dict | None = None) -> None:
         super().__init__(message or self.default_message())
         if status_code is not None:
             self.status_code = status_code
         self.details = details
+        #: extra response headers the responder forwards verbatim
+        #: (e.g. Retry-After on overload rejections)
+        self.headers = dict(headers or {})
 
     def default_message(self) -> str:
         return "internal server error"
@@ -113,6 +117,19 @@ class ErrorServiceUnavailable(HTTPError):
 
     def default_message(self) -> str:
         return "service unavailable"
+
+
+class ErrorTooManyRequests(HTTPError):
+    """Per-tenant rate limit exceeded (token buckets in
+    serving/scheduler.py). INFO, not WARN: a tenant hitting its own
+    configured limit is the limiter working, not service distress —
+    the scheduler WARNs separately when SLO-driven shedding starts."""
+
+    status_code = 429
+    log_level = INFO
+
+    def default_message(self) -> str:
+        return "too many requests"
 
 
 def status_and_level_for(err: BaseException) -> tuple[int, Level]:

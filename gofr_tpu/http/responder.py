@@ -59,7 +59,9 @@ class Responder:
         if error is not None:
             status, _ = status_and_level_for(error)
             envelope: dict[str, Any] = {"error": self._error_obj(error)}
-            return ResponseData(status=status, body=_json_bytes(envelope))
+            return ResponseData(status=status, body=_json_bytes(envelope),
+                                headers=dict(getattr(error, "headers",
+                                                     None) or {}))
 
         if isinstance(result, Redirect):
             status = 302 if method in ("GET", "HEAD") else 303

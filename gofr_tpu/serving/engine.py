@@ -114,6 +114,14 @@ class GenRequest:
                                     # preemption" column
     waste_spec_s: float = 0.0       # slice of device_s spent on this
                                     # request's REJECTED draft tokens
+    lane: str = "interactive"      # scheduler lane (interactive |
+                                   # background); explicit submit() lane
+                                   # wins over the config's tenant->lane
+                                   # mapping
+    reject: Any = None             # scheduler.SchedReject stamped when
+                                   # admission refused the request —
+                                   # handlers turn it into 429/503 with
+                                   # Retry-After instead of a blanket 503
 
     def _emit(self, token: int | None) -> None:
         if self.out_queue is not None and self.loop is not None:
@@ -306,6 +314,13 @@ class EngineConfig:
     #: p99 does. O(1) host set lookups; engines that never warm up
     #: never seal, so cold compiles stay silent.
     recompile_sentinel: bool = True
+    #: admission/scheduling/shedding policy (serving/scheduler.py):
+    #: weighted fair-share dequeue over per-tenant sub-queues,
+    #: interactive/background lanes with starvation preemption,
+    #: token-bucket rate limits, burn-rate-driven shedding. None =
+    #: default SchedulerConfig (fair-share ON — single-tenant traffic
+    #: is strict FIFO, bit-identical to the old queue).
+    scheduler: Any = None
 
 
 class Engine:
@@ -614,6 +629,20 @@ class Engine:
         self._last_beat = time.time()
         self._watchdog: Any = None  # StallWatchdog, started with start()
 
+        # admission queue: the tenant/SLO-aware Scheduler (same
+        # put/pop_batch/qsize/close contract as native/batch_queue) —
+        # fair-share DRR over per-tenant sub-queues, lanes, rate
+        # limits and burn-rate shedding, all at admission boundaries.
+        # Single-tenant traffic is strict FIFO, bit-identical to the
+        # old queue. Built before attach_metrics so its gauges wire up.
+        from .scheduler import Scheduler, SchedulerConfig
+        sched_cfg = (config.scheduler if config.scheduler is not None
+                     else SchedulerConfig())
+        self.waiting = Scheduler(sched_cfg, config.max_waiting,
+                                 ledger=self.usage_ledger,
+                                 slo_source=lambda: self.slo,
+                                 metrics=metrics, logger=logger)
+
         if self.metrics is not None:
             self.attach_metrics(self.metrics)
 
@@ -657,10 +686,6 @@ class Engine:
             self._prefix_enabled = False  # sharing needs page tables
         self.lengths = np.zeros(cfg.max_batch, np.int32)       # kv length per slot
         self.active: list[GenRequest | None] = [None] * cfg.max_batch
-        # admission queue: C++ waitable batch queue when a toolchain
-        # exists (gofr_tpu/native), queue.Queue-semantics fallback
-        from ..native.batch_queue import new_request_queue
-        self.waiting = new_request_queue(config.max_waiting)
         # already-admitted work bounced back (preemption, slot races,
         # chunk-walk pacing): re-enters ahead of the public queue and
         # NEVER counts against the admission bound — engine-thread
@@ -920,9 +945,28 @@ class Engine:
             ("app_slo_error_budget_remaining",
              "fraction of the availability error budget left over "
              "SLOConfig.budget_window_s"),
+            ("app_sched_lane_depth",
+             "queued requests per scheduler lane (interactive/"
+             "background)"),
+            ("app_sched_tenant_share",
+             "per-tenant fraction of windowed device time "
+             "(the fair-share dequeue signal)"),
+            ("app_sched_shed_active",
+             "1 while a burn-rate shed episode is active"),
         ):
             if metrics.get(name) is None:
                 metrics.new_gauge(name, desc)
+        for name, desc in (
+            ("app_sched_rejections",
+             "admission refusals by cause (queue_full/rate_limited/"
+             "shed) and tenant"),
+            ("app_sched_preemptions",
+             "scheduler-initiated background preemptions to unstarve "
+             "the interactive lane (priced by the preempt_recompute "
+             "goodput ledger)"),
+        ):
+            if metrics.get(name) is None:
+                metrics.new_counter(name, desc)
         ttft_buckets = (0.005, 0.01, 0.025, 0.05, 0.075, 0.1, 0.15,
                         0.25, 0.5, 1, 2, 5)
         for name, desc, buckets in (
@@ -957,6 +1001,9 @@ class Engine:
             self.usage_ledger.metrics = metrics
         if self.slo is not None and self.slo.metrics is None:
             self.slo.metrics = metrics
+        if getattr(self.waiting, "metrics", None) is None \
+                and hasattr(self.waiting, "publish_gauges"):
+            self.waiting.metrics = metrics
 
     def warmup(self, prompt_lens: tuple = (1,), decode: bool = True,
                chunked: bool = False) -> None:
@@ -1084,7 +1131,8 @@ class Engine:
     def submit(self, prompt_tokens: list[int],
                params: SamplingParams | None = None, *,
                traceparent: str | None = None,
-               tenant: str | None = None) -> GenRequest:
+               tenant: str | None = None,
+               lane: str = "interactive") -> GenRequest:
         """Called from the asyncio loop; returns a request whose
         ``out_queue`` yields token ids and then ``None``.
 
@@ -1095,12 +1143,14 @@ class Engine:
         ``tenant`` is the resolved bounded-cardinality accounting
         label (handlers pass it from the auth principal); it rides the
         request into spans, the flight-recorder log and the usage
-        ledger."""
+        ledger. ``lane`` routes the request into the scheduler's
+        interactive or background lane (the config's
+        ``background_tenants`` mapping applies when left default)."""
         params = params or SamplingParams()
         prompt_tokens = self._clamp_prompt(list(prompt_tokens),
                                            params.max_new_tokens)
         req = GenRequest(prompt_tokens=prompt_tokens, params=params,
-                         tenant=tenant)
+                         tenant=tenant, lane=lane)
         if self.tracer is not None:
             parent = self.tracer.current_span()
             if parent is not None:
@@ -1118,10 +1168,16 @@ class Engine:
         except RuntimeError:  # submitted from a plain thread (tests/bench)
             req.loop = None
             req.out_queue = None
-        if not self.waiting.put(req):  # full/closed: fail loudly, never hang
-            self._fail(req, "engine overloaded: waiting queue full"
-                       if self._running else
-                       "engine not accepting requests")
+        if not self.waiting.put(req):  # refused/closed: fail loudly,
+            # never hang. The scheduler stamps a typed reject
+            # (queue_full / rate_limited / shed) for policy refusals;
+            # a closed queue stamps nothing.
+            if req.reject is not None and self._running:
+                self._fail(req, req.reject.message)
+            else:
+                self._fail(req, "engine overloaded: waiting queue full"
+                           if self._running else
+                           "engine not accepting requests")
         return req
 
     def submit_sync(self, prompt_tokens: list[int],
@@ -1597,6 +1653,8 @@ class Engine:
         return True
 
     def _release_pages(self, slot: int) -> None:
+        if self.config.kv_layout != "paged":
+            return  # slot layout: kv rows are per-slot, nothing pooled
         n = int(self._slot_pages[slot])
         if n:
             self._tables_dirty = True
@@ -1729,6 +1787,40 @@ class Engine:
                 return False
             self._preempt(max(
                 victims, key=lambda i: self.active[i].admit_order))
+        return True
+
+    @hot_path_boundary(
+        "starvation-triggered preemption decision at the admission boundary; rate-capped by the scheduler, not steady-state")
+    def _sched_starvation_preempt(self) -> bool:
+        """When the scheduler reports interactive starvation with the
+        batch full, preempt the newest background slot through the
+        existing preemption-by-recompute machinery (the
+        ``preempt_recompute`` goodput ledger prices it) and route the
+        victim back through the scheduler instead of the ``_requeued``
+        fast lane — which bypasses admission and would hand the freed
+        slot straight back to the victim."""
+        sched = self.waiting
+        if not hasattr(sched, "starving_interactive") \
+                or not sched.starving_interactive():
+            return False
+        victims = [i for i, r in enumerate(self.active)
+                   if r is not None and not r.pending_prefill
+                   and not r.cancelled
+                   and getattr(r, "lane", None) == "background"]
+        if not victims:
+            return False
+        # newest victim loses; the slot layout never stamps
+        # admit_order (-1 everywhere), so fall back to submit time
+        slot = max(victims, key=lambda i: (self.active[i].admit_order,
+                                           self.active[i].submitted_at))
+        req = self.active[slot]
+        self._preempt(slot)
+        if id(req) in self._requeued_set:
+            self._requeued_set.discard(id(req))
+            self._requeued = [r for r in self._requeued if r is not req]
+            sched.readmit(req)  # head of its background sub-queue
+        if hasattr(sched, "note_preempted"):
+            sched.note_preempted()
         return True
 
     @hot_path_boundary(
@@ -1886,10 +1978,21 @@ class Engine:
                 device_s=req.device_s,
                 waste_recompute_s=req.waste_recompute_s,
                 waste_spec_s=req.waste_spec_s, t=end)
-        if self.slo is not None and not req.cancelled:
-            self.slo.record(self.slo.judge(
-                error=req.error, ttft_s=ttft_s, tpot_s=tpot_s,
-                e2e_s=e2e_s), t=end)
+        if self.slo is not None and not req.cancelled \
+                and getattr(req, "reject", None) is None:
+            # typed admission refusals (429/shed) are policy, not
+            # service failures: counting them as SLO errors would let
+            # one tenant's flood burn the global budget and trip the
+            # shedder against everyone else (a rejection -> burn ->
+            # shed feedback loop). They are priced by
+            # app_sched_rejections instead.
+            good = self.slo.judge(error=req.error, ttft_s=ttft_s,
+                                  tpot_s=tpot_s, e2e_s=e2e_s)
+            self.slo.record(good, t=end)
+            # the same verdict feeds the scheduler's per-tenant burn
+            # column (the /debug/scheduler victim/offender view)
+            if hasattr(self.waiting, "note_retire"):
+                self.waiting.note_retire(req.tenant, good, t=end)
         if self.recorder.enabled:
             from .observability import request_summary
             self.recorder.record_request(request_summary(req))
@@ -2849,6 +2952,8 @@ class Engine:
         mfu = (tps * self._flops_per_token / self._peak_flops
                if self._flops_per_token and self._peak_flops else 0.0)
         m.set_gauge("app_engine_mfu", round(mfu, 6))
+        if hasattr(self.waiting, "publish_gauges"):
+            self.waiting.publish_gauges(m)
         cfg = self.config
         if cfg.kv_layout == "paged":
             used = self._n_pages - len(self._free_pages)
@@ -2880,6 +2985,13 @@ class Engine:
                 self._last_beat = time.time()
                 free = sum(1 for r in self.active if r is None)
                 busy = free < self.config.max_batch
+                if free == 0 and not self._requeued:
+                    # full batch, nothing bounced back: if the
+                    # interactive lane is starving behind background
+                    # work, preempt-by-recompute frees a slot for it
+                    # (rate-capped by the scheduler)
+                    if self._sched_starvation_preempt():
+                        free = sum(1 for r in self.active if r is None)
                 if free > 0 or self._requeued:
                     # requeued (already-admitted) work goes first,
                     # bypasses the admission bound, and drains even

@@ -13,9 +13,28 @@ import time
 from typing import Any
 
 from ..http.errors import (ErrorInvalidParam, ErrorMissingParam,
-                           ErrorServiceUnavailable)
+                           ErrorServiceUnavailable, ErrorTooManyRequests)
 from ..http.response import Raw, Stream
 from .engine import Engine, SamplingParams
+from .scheduler import retry_after_header
+
+
+def admission_error(req: Any) -> Exception:
+    """Typed HTTP error for a refused submission. The scheduler stamps
+    a :class:`~gofr_tpu.serving.scheduler.SchedReject` on policy
+    refusals — rate limits surface as 429, queue-full/shed as 503,
+    both carrying ``Retry-After`` and a machine-readable ``details``
+    object (code, tenant, retry_after_s). Untyped failures (engine
+    closed/stopped) keep the plain 503."""
+    rej = getattr(req, "reject", None)
+    if rej is None:
+        return ErrorServiceUnavailable(req.error)
+    details = {"code": rej.code, "tenant": rej.tenant,
+               "retry_after_s": round(rej.retry_after_s, 3)}
+    cls = (ErrorTooManyRequests if rej.code == "rate_limited"
+           else ErrorServiceUnavailable)
+    return cls(req.error, details=details,
+               headers=retry_after_header(rej))
 
 
 def make_chat_handler(engine: Engine, tokenizer: Any):
@@ -60,8 +79,10 @@ def make_chat_handler(engine: Engine, tokenizer: Any):
                             traceparent=ctx.header("traceparent") or None,
                             tenant=tenant)
         if req.error:
-            # instant failure = admission refused, not a generation bug
-            raise ErrorServiceUnavailable(req.error)
+            # instant failure = admission refused, not a generation
+            # bug; the scheduler's typed reject picks 429 vs 503 and
+            # carries Retry-After
+            raise admission_error(req)
 
         if stream:
             async def sse():

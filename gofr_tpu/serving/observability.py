@@ -849,6 +849,13 @@ class SLOTracker:
         self._total = 0
         self._bad = 0
         self._escalated = False
+        #: monotonic high-water mark over fed timestamps: record()
+        #: clamps each t up to it so the per-window deques stay sorted
+        #: — _evict_locked pops from the head while events age out,
+        #: which silently under- or over-counts if a late-arriving
+        #: older timestamp lands behind a newer one (replay feeds and
+        #: multi-source clocks do this)
+        self._last_t = float("-inf")
 
     # ------------------------------------------------------------ feed
     def judge(self, *, error: str | None, ttft_s: float | None,
@@ -867,6 +874,10 @@ class SLOTracker:
     def record(self, good: bool, t: float | None = None) -> None:
         t = time.time() if t is None else t
         with self._lock:
+            # modest reordering tolerated: clamp to the newest seen
+            # timestamp so windows stay sorted and eviction stays exact
+            t = max(t, self._last_t)
+            self._last_t = t
             self._total += 1
             self._bad += 0 if good else 1
             for w, win in self._wins.items():

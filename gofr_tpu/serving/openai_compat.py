@@ -180,6 +180,19 @@ class OpenAIRoutes:
         prompt_tokens = self.tokenizer.encode(prompt)
         req = self.engine.submit(prompt_tokens, params, tenant=tenant)
         if req.error:
+            # typed scheduler rejects map to OpenAI's taxonomy: rate
+            # limits are 429 rate_limit_error with Retry-After, the
+            # rest stay 503 server_error
+            rej = getattr(req, "reject", None)
+            if rej is not None:
+                from .scheduler import retry_after_header
+                err = _OpenAIError(
+                    req.error,
+                    status=429 if rej.code == "rate_limited" else 503,
+                    err_type="rate_limit_error"
+                    if rej.code == "rate_limited" else "server_error")
+                err.headers.update(retry_after_header(rej))
+                raise err
             raise _OpenAIError(req.error, status=503,
                                err_type="server_error")
         oid = (("chatcmpl-" if chat else "cmpl-")

@@ -46,6 +46,13 @@ THROUGHPUT_KEYS = ("chat_req_per_s", "chat_tok_per_s",
                    "prefill_tok_per_s_kernel", "prefill_tok_per_s_view",
                    "prod_tok_per_s", "prod_req_per_s", "goodput_ratio")
 
+#: goodput_ratio only gates when BOTH entries accumulated at least
+#: this much busy device time — tiny CPU headline runs have ~20 ms of
+#: busy time, where a single extra padded prefill swings the ratio
+#: past the 10% threshold (pure noise, the flappy gate of record).
+#: Entries predating the goodput_busy_s headline also skip the gate.
+GOODPUT_BUSY_FLOOR_S = 1.0
+
 
 def is_latency(key: str) -> bool:
     return key.endswith("_ms")
@@ -97,6 +104,15 @@ def diff(prev: dict, cur: dict, threshold: float) -> tuple[list, list]:
             lines.append(f"  {key:28s} {old} -> {new}  (zero baseline)")
             continue
         change = (new - old) / old
+        if key == "goodput_ratio":
+            busy_prev = pm.get("goodput_busy_s")
+            busy_cur = cm.get("goodput_busy_s")
+            if busy_prev is None or busy_cur is None \
+                    or min(busy_prev, busy_cur) < GOODPUT_BUSY_FLOOR_S:
+                lines.append(f"  {key:28s} {old:>12} -> {new:>12}  "
+                             f"{change:+7.1%}  (busy below "
+                             f"{GOODPUT_BUSY_FLOOR_S}s floor — not gated)")
+                continue
         bad = change < -threshold if key in THROUGHPUT_KEYS else \
             change > threshold if is_latency(key) else False
         marker = "  REGRESSION" if bad else ""
@@ -141,7 +157,7 @@ def self_test() -> int:
     base = {"status": "fresh", "platform": "cpu", "host": "h", "ts": 1.0,
             "metrics": {"chat_tok_per_s": 1000.0, "chat_req_per_s": 50.0,
                         "p50_ttft_ms": 40.0, "goodput_ratio": 0.8,
-                        "waste_padding_s": 1.0}}
+                        "goodput_busy_s": 5.0, "waste_padding_s": 1.0}}
 
     def entry(ts, **overrides):
         rec = json.loads(json.dumps(base))
@@ -166,6 +182,13 @@ def self_test() -> int:
          [base, entry(2.0, goodput_ratio=0.77)], 0),
         ("waste seconds double but never gate",
          [base, entry(2.0, waste_padding_s=2.0)], 0),
+        ("goodput drop below the busy floor never gates",
+         [dict(base, metrics=dict(base["metrics"],
+                                  goodput_busy_s=0.02)),
+          entry(2.0, goodput_ratio=0.5, goodput_busy_s=0.02)], 0),
+        ("goodput drop without busy_s (old ledger entry) never gates",
+         [dict(base, metrics={"goodput_ratio": 0.8}),
+          dict(entry(2.0), metrics={"goodput_ratio": 0.5})], 0),
         ("single entry passes vacuously",
          [base], 0),
         ("cached entries never gate",

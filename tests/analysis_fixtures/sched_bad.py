@@ -1,0 +1,24 @@
+"""hot-path-purity: scheduler bookkeeping INSIDE the hot loop — the
+anti-pattern serving/scheduler.py exists to prevent. Lines matter —
+test_analysis.py pins them."""
+import time
+
+from gofr_tpu.analysis import hot_path
+
+
+class Engine:
+    @hot_path
+    def admit_pass(self, batch):
+        # admission-policy work belongs behind a boundary (the real
+        # Scheduler's put/note_retire); doing it inline in a hot root
+        # drags wall clocks, metrics and logging into the decode loop
+        now = time.time()                               # L15: wall clock
+        self.metrics.increment_counter("app_sched_rejections")  # L16
+        self.logger.warn("shedding load")               # L17: logging
+        return self._account(batch, now)
+
+    def _account(self, batch, now):
+        # undecorated fair-share bookkeeping statically reached from
+        # the hot root: the closure walk must flag it too
+        self.metrics.set_gauge("app_sched_lane_depth", len(batch))  # L23
+        return time.time()                              # L24: wall clock

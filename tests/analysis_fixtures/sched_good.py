@@ -1,0 +1,37 @@
+"""hot-path-purity: the clean scheduler twin — admission and retire
+decisions exit the hot closure through @hot_path_boundary entry
+points (the serving/scheduler.py contract). None of this may flag."""
+import time
+
+from gofr_tpu.analysis import hot_path, hot_path_boundary
+
+
+class Engine:
+    @hot_path
+    def admit_pass(self, batch):
+        # the hot root only touches the boundary entry points — the
+        # walk stops there, exactly like the engine loop calling the
+        # real Scheduler's pop_batch/starvation hook
+        taken = self._sched_pop(len(batch))
+        self._sched_retire(taken)
+        return taken
+
+    @hot_path_boundary(
+        "admission boundary: lock-guarded host bookkeeping off the decode graph")
+    def _sched_pop(self, n):
+        # inside the boundary the scheduler may consult clocks, burn
+        # rates and metrics — that is the point of the boundary
+        self.metrics.set_gauge("app_sched_lane_depth", float(n))
+        return time.time()
+
+    @hot_path_boundary(
+        "retire boundary: per-tenant burn bookkeeping fed at request retire")
+    def _sched_retire(self, t):
+        self.metrics.increment_counter("app_sched_rejections")
+        self.logger.warn("shed episode", t=t)
+
+    def reconfigure(self):
+        # undecorated and unreachable from any hot root (an app-thread
+        # config swap): not scanned
+        self.logger.info("scheduler reconfigured")
+        return time.time()

@@ -56,6 +56,46 @@ class TestHotPathPurity:
         assert violations(got, "hot-path-purity") == []
 
 
+# ---------------------------------------------------- scheduler contract
+class TestSchedulerHotPathContract:
+    """The serving/scheduler.py contract, lint-enforced: admission/
+    retire bookkeeping (clocks, metrics, logging, burn-rate reads) is
+    legal ONLY behind @hot_path_boundary entry points — inline in a
+    hot root, or in an undecorated helper the closure reaches, it
+    must flag."""
+
+    def test_inline_scheduler_bookkeeping_flags(self):
+        got = violations(lint("sched_bad.py"), "hot-path-purity")
+        lines = {f.line for f in got}
+        # the three direct violations in admit_pass() ...
+        assert {15, 16, 17} <= lines
+        # ... and the closure-reached fair-share helper
+        assert {23, 24} <= lines
+
+    def test_boundary_entry_points_are_clean(self):
+        assert violations(lint("sched_good.py"), "hot-path-purity") == []
+
+    def test_live_scheduler_entry_points_declare_boundaries(self):
+        # the real module, not a fixture: the entry points that touch
+        # admission/retire paths carry the boundary annotation with a
+        # non-empty reason, so the contract survives refactors
+        from gofr_tpu.serving.scheduler import Scheduler
+        for entry in (Scheduler.put, Scheduler.note_retire):
+            reason = getattr(entry, "__gofr_hot_path_boundary__", "")
+            assert isinstance(reason, str) and reason.strip(), entry
+
+    def test_live_repo_hot_closure_excludes_scheduler(self):
+        # with the scheduler ON by default, the engine's hot closure
+        # must not grow into scheduler.py (the zero-hot-path invariant)
+        from gofr_tpu.analysis.callgraph import CallGraph
+        from gofr_tpu.analysis.core import load_project
+        project = load_project([REPO / "gofr_tpu" / "serving"], root=REPO)
+        closure = CallGraph(project).hot_closure()
+        offenders = [str(k) for k in closure
+                     if k.module.endswith("scheduler.py")]
+        assert not offenders, offenders
+
+
 # ---------------------------------------------------------------- locks
 class TestLockDiscipline:
     def test_bad_fixture(self):

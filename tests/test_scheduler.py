@@ -1,0 +1,598 @@
+"""Scheduler tests: the admission/fair-share/rate-limit/shed unit
+contract over serving/scheduler.py, SLOTracker out-of-order feeds, the
+engine's starvation-preemption hook, and the REPLAY EVIDENCE for the
+policy itself — a two-tenant contention workload replayed through a
+FIFO engine and a fair-share engine, asserting the victim tenant's
+fast burn rate is strictly lower under fair-share while the aggregate
+goodput ratio degrades by at most 5%.
+"""
+
+import queue
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from gofr_tpu.serving.observability import (SLOConfig, SLOTracker,
+                                            WORKLOAD_FORMAT,
+                                            WORKLOAD_VERSION)
+from gofr_tpu.serving.scheduler import (BACKGROUND, INTERACTIVE,
+                                        QUEUE_FULL, RATE_LIMITED, SHED,
+                                        RateLimit, SchedReject,
+                                        Scheduler, SchedulerConfig,
+                                        retry_after_header)
+
+
+def req(tenant=None, lane=INTERACTIVE, n_prompt=4, max_new=8,
+        submitted_at=None):
+    return SimpleNamespace(
+        tenant=tenant, lane=lane, prompt_tokens=list(range(n_prompt)),
+        params=SimpleNamespace(max_new_tokens=max_new),
+        submitted_at=time.time() if submitted_at is None
+        else submitted_at,
+        reject=None)
+
+
+def drain(sched, n=64):
+    out = []
+    while len(out) < n:
+        batch = sched.pop_batch(1, first_wait_s=0.0)
+        if not batch:
+            break
+        out.extend(batch)
+    return out
+
+
+class FakeLedger:
+    """rollup() shaped like UsageLedger's windowed form."""
+
+    def __init__(self, device_s):
+        self.device_s = device_s
+
+    def rollup(self, tenant=None, window_s=None):
+        return {"window": "5m", "partial": False,
+                "tenants": {name: {"device_s": s, "prompt_tokens": 100,
+                                   "completion_tokens": 100}
+                            for name, s in self.device_s.items()}}
+
+
+class FakeSLO:
+    def __init__(self, burn=0.0, threshold=14.4):
+        self.burn = burn
+        self.threshold = threshold
+        self.config = SimpleNamespace(availability=0.999)
+
+    def state(self):
+        return {"fast_burn": {"burn_rate": self.burn,
+                              "threshold": self.threshold,
+                              "tripped": self.burn >= self.threshold}}
+
+
+class FakeLogger:
+    def __init__(self):
+        self.warns = []
+
+    def warn(self, msg, **kw):
+        self.warns.append((msg, kw))
+
+
+def force_slo_recheck(sched):
+    """Defeat the 0.25s fast-burn read throttle between puts."""
+    sched._slo_checked = float("-inf")
+
+
+# ------------------------------------------------------- admission unit
+class TestAdmission:
+    def test_unknown_policy_raises(self):
+        with pytest.raises(ValueError):
+            Scheduler(SchedulerConfig(policy="lifo"))
+        sched = Scheduler()
+        with pytest.raises(ValueError):
+            sched.reconfigure(SchedulerConfig(policy="lifo"))
+
+    def test_single_tenant_is_strict_fifo(self):
+        # one tenant = one sub-queue: fair-share must be bit-identical
+        # to the old queue's arrival order
+        sched = Scheduler(SchedulerConfig(policy="fair"))
+        items = [req(tenant="a") for _ in range(5)]
+        for it in items:
+            assert sched.put(it)
+        assert drain(sched) == items
+
+    def test_fifo_policy_is_global_arrival_order(self):
+        sched = Scheduler(SchedulerConfig(policy="fifo"))
+        items = [req(tenant=t) for t in
+                 ("a", "b", "a", "c", "b", "a")]
+        for it in items:
+            assert sched.put(it)
+        assert drain(sched) == items
+
+    def test_queue_full_typed_reject(self):
+        sched = Scheduler(SchedulerConfig(), capacity=2)
+        assert sched.put(req(tenant="a"))
+        assert sched.put(req(tenant="a"))
+        third = req(tenant="a")
+        assert not sched.put(third)
+        rej = third.reject
+        assert isinstance(rej, SchedReject)
+        assert rej.code == QUEUE_FULL and rej.tenant == "a"
+        assert rej.retry_after_s == sched.config.retry_after_s
+        assert sched.counters["rejected"][QUEUE_FULL] == 1
+        # already-admitted work re-entering is exempt from the bound
+        victim = drain(sched, 1)[0]
+        assert sched.put(req(tenant="a"))  # refill to capacity
+        sched.readmit(victim)
+        assert sched.qsize() == 3  # over the bound, by design
+        assert sched.counters["readmitted"] == 1
+
+    def test_readmit_enters_at_the_head(self):
+        sched = Scheduler(SchedulerConfig())
+        a, b, c = (req(tenant="t", lane=BACKGROUND) for _ in range(3))
+        for it in (a, b, c):
+            assert sched.put(it)
+        assert drain(sched, 1) == [a]
+        sched.readmit(a)  # preemption victim: back to the head
+        assert drain(sched) == [a, b, c]
+
+    def test_close_contract(self):
+        sched = Scheduler(SchedulerConfig())
+        sched.close()
+        it = req()
+        assert not sched.put(it)
+        # closed queues stamp nothing: the engine's "not accepting
+        # requests" failure stands
+        assert it.reject is None
+        assert sched.pop_batch(4, first_wait_s=0.0) is None
+
+    def test_get_nowait_and_qsize(self):
+        sched = Scheduler(SchedulerConfig())
+        with pytest.raises(queue.Empty):
+            sched.get_nowait()
+        it = req()
+        sched.put(it)
+        assert sched.qsize() == 1
+        assert sched.get_nowait() is it
+        assert sched.qsize() == 0
+
+
+# ----------------------------------------------------------- rate limit
+class TestRateLimits:
+    def test_rps_bucket_rejects_with_retry_after(self):
+        sched = Scheduler(SchedulerConfig(
+            rate_limits={"a": RateLimit(rps=1.0, burst=1.0)}))
+        assert sched.put(req(tenant="a"))
+        second = req(tenant="a")
+        assert not sched.put(second)
+        rej = second.reject
+        assert rej.code == RATE_LIMITED and rej.tenant == "a"
+        assert rej.retry_after_s > 0
+        hdr = retry_after_header(rej)
+        assert int(hdr["Retry-After"]) >= 1
+        # another tenant has its own bucket
+        assert sched.put(req(tenant="b"))
+        assert sched.counters["rejected"][RATE_LIMITED] == 1
+
+    def test_prompt_token_bucket(self):
+        sched = Scheduler(SchedulerConfig(
+            rate_limits={"a": RateLimit(prompt_tps=10.0,
+                                        prompt_burst=10.0)}))
+        assert sched.put(req(tenant="a", n_prompt=8))
+        big = req(tenant="a", n_prompt=8)  # bucket holds only 2 more
+        assert not sched.put(big)
+        assert big.reject.code == RATE_LIMITED
+
+    def test_wildcard_limit_applies_to_unlisted_tenants(self):
+        sched = Scheduler(SchedulerConfig(
+            rate_limits={"*": RateLimit(rps=1.0, burst=1.0)}))
+        assert sched.put(req(tenant="anyone"))
+        blocked = req(tenant="anyone")
+        assert not sched.put(blocked)
+        assert blocked.reject.code == RATE_LIMITED
+
+    def test_readmit_bypasses_buckets(self):
+        sched = Scheduler(SchedulerConfig(
+            rate_limits={"a": RateLimit(rps=1.0, burst=1.0)}))
+        first = req(tenant="a")
+        assert sched.put(first)
+        drain(sched, 1)
+        sched.readmit(first)  # its admission was already paid
+        assert sched.qsize() == 1
+
+
+# ------------------------------------------------------ fairness / lanes
+class TestFairShareAndLanes:
+    def test_interactive_lane_dequeues_first(self):
+        sched = Scheduler(SchedulerConfig())
+        bg = [req(tenant="t", lane=BACKGROUND) for _ in range(2)]
+        for it in bg:
+            sched.put(it)
+        fg = req(tenant="t")
+        sched.put(fg)
+        assert drain(sched) == [fg] + bg
+
+    def test_background_tenants_mapping(self):
+        sched = Scheduler(SchedulerConfig(background_tenants=("bulk",)))
+        it = req(tenant="bulk")
+        sched.put(it)
+        assert it.lane == BACKGROUND
+        # explicit background submission wins over the default too
+        it2 = req(tenant="chat", lane=BACKGROUND)
+        sched.put(it2)
+        assert it2.lane == BACKGROUND
+
+    def test_ledger_share_starves_the_hog(self):
+        # hot tenant owns nearly all windowed device time: the victim's
+        # later arrival must still dequeue first
+        sched = Scheduler(SchedulerConfig(),
+                          ledger=FakeLedger({"hot": 10.0,
+                                             "victim": 0.1}))
+        hot = [req(tenant="hot") for _ in range(3)]
+        for it in hot:
+            sched.put(it)
+        cold = req(tenant="victim")
+        sched.put(cold)
+        order = drain(sched)
+        assert order[0] is cold
+
+    def test_weights_scale_entitlement(self):
+        # same measured share, but tenant "paid" carries weight 10:
+        # its weighted share is lower, so it dequeues first
+        sched = Scheduler(SchedulerConfig(weights={"paid": 10.0}),
+                          ledger=FakeLedger({"free": 1.0, "paid": 1.0}))
+        free = req(tenant="free")
+        sched.put(free)
+        paid = req(tenant="paid")
+        sched.put(paid)
+        assert drain(sched)[0] is paid
+
+    def test_inflight_debt_interleaves_before_ledger_catches_up(self):
+        # zero ledger shares (cold start): after dequeuing one hot
+        # request the hot tenant carries in-flight debt, so the next
+        # pick is the victim even though it arrived last
+        sched = Scheduler(SchedulerConfig())
+        hot = [req(tenant="hot") for _ in range(4)]
+        for it in hot:
+            sched.put(it)
+        cold = req(tenant="victim")
+        sched.put(cold)
+        first = drain(sched, 1)[0]
+        assert first is hot[0]  # tie on zero shares: arrival order
+        assert drain(sched, 1)[0] is cold
+
+    def test_reconfigure_rebuckets_and_preserves_burn(self):
+        sched = Scheduler(SchedulerConfig())
+        sched.note_retire("bulk", good=False)
+        queued = req(tenant="bulk")
+        sched.put(queued)
+        assert queued.lane == INTERACTIVE
+        sched.reconfigure(SchedulerConfig(background_tenants=("bulk",)))
+        assert queued.lane == BACKGROUND
+        st = sched.state()
+        assert st["tenants"]["bulk"]["queued"][BACKGROUND] == 1
+        assert st["tenants"]["bulk"]["burn"]["bad"] == 1
+        assert drain(sched) == [queued]
+
+
+# ------------------------------------------------------------- shedding
+class TestShedding:
+    def make(self, slo, **cfg):
+        logger = FakeLogger()
+        sched = Scheduler(
+            SchedulerConfig(**cfg),
+            ledger=FakeLedger({"hot": 20.0, "victim": 1.0}),
+            slo_source=lambda: slo, logger=logger)
+        return sched, logger
+
+    def test_episode_sheds_background_first_with_hysteresis(self):
+        slo = FakeSLO(burn=20.0)
+        sched, logger = self.make(slo)
+        bg = req(tenant="victim", lane=BACKGROUND)
+        assert not sched.put(bg)
+        assert bg.reject.code == SHED
+        assert sched.counters["shed_episodes"] == 1
+        assert len(logger.warns) == 1  # WARN once per episode
+        # interactive traffic from the under-share tenant still flows
+        assert sched.put(req(tenant="victim"))
+
+        # burn falls below the trip point but above the exit ratio:
+        # hysteresis keeps the episode open (no re-admit flapping)
+        slo.burn = 10.0  # threshold 14.4, exit at 7.2
+        force_slo_recheck(sched)
+        still = req(tenant="victim", lane=BACKGROUND)
+        assert not sched.put(still)
+        assert len(logger.warns) == 1  # same episode, no second WARN
+
+        # full recovery ends the episode; background flows again
+        slo.burn = 5.0
+        force_slo_recheck(sched)
+        assert sched.put(req(tenant="victim", lane=BACKGROUND))
+
+        # a fresh trip is a NEW episode: counted and warned again
+        slo.burn = 20.0
+        force_slo_recheck(sched)
+        again = req(tenant="victim", lane=BACKGROUND)
+        assert not sched.put(again)
+        assert sched.counters["shed_episodes"] == 2
+        assert len(logger.warns) == 2
+
+    def test_over_share_interactive_sheds_under_share_survives(self):
+        sched, _ = self.make(FakeSLO(burn=20.0), shed_overshare=1.5)
+        hog = req(tenant="hot")  # 20/21 of the window: over-share
+        assert not sched.put(hog)
+        assert hog.reject.code == SHED
+        assert sched.put(req(tenant="victim"))
+
+    def test_shed_disabled_is_inert(self):
+        sched, logger = self.make(FakeSLO(burn=100.0), shed=False)
+        assert sched.put(req(tenant="victim", lane=BACKGROUND))
+        assert sched.counters["shed_episodes"] == 0
+        assert not logger.warns
+
+
+# ------------------------------------------------- starvation decision
+class TestStarvation:
+    def test_decision_is_rate_capped_and_counted_separately(self):
+        sched = Scheduler(SchedulerConfig(starvation_s=0.01,
+                                          preempt_min_interval_s=30.0))
+        old = req(tenant="a", submitted_at=time.time() - 5.0)
+        sched.put(old)
+        assert sched.starving_interactive()
+        # the DECISION armed the rate cap — a victimless attempt must
+        # not re-fire every engine pass
+        assert not sched.starving_interactive()
+        assert sched.counters["preemptions"] == 0
+        sched.note_preempted()  # the engine actually preempted
+        assert sched.counters["preemptions"] == 1
+
+    def test_fifo_and_disabled_never_starve(self):
+        for cfg in (SchedulerConfig(policy="fifo", starvation_s=0.01),
+                    SchedulerConfig(starvation_s=0.0)):
+            sched = Scheduler(cfg)
+            sched.put(req(tenant="a",
+                          submitted_at=time.time() - 5.0))
+            assert not sched.starving_interactive()
+
+
+# ------------------------------------------------------- state contract
+class TestState:
+    def test_state_shape(self):
+        sched = Scheduler(
+            SchedulerConfig(rate_limits={"a": RateLimit(rps=5.0)}),
+            ledger=FakeLedger({"a": 3.0, "b": 1.0}))
+        sched.put(req(tenant="a"))
+        sched.put(req(tenant="b", lane=BACKGROUND))
+        sched.note_retire("a", good=False)
+        st = sched.state()
+        assert st["policy"] == "fair"
+        assert st["lanes"] == {INTERACTIVE: 1, BACKGROUND: 1}
+        assert st["depth"] == 2
+        a = st["tenants"]["a"]
+        assert a["queued"][INTERACTIVE] == 1
+        assert 0.0 < a["device_share"] < 1.0
+        assert a["burn"]["bad"] == 1 and a["burn"]["burn_rate"] > 0
+        assert "rps_bucket_level" in a
+        assert st["shedding"]["enabled"] and not st["shedding"]["active"]
+        assert st["counters"]["admitted"] == 2
+
+    def test_tenant_burn_evicts_outside_window(self):
+        sched = Scheduler(SchedulerConfig(burn_window_s=10.0))
+        sched.note_retire("a", good=False, t=time.time() - 60.0)
+        sched.note_retire("a", good=True)
+        burn = sched.state()["tenants"]["a"]["burn"]
+        assert burn == {"total": 1, "bad": 0, "burn_rate": 0.0}
+
+    def test_retry_after_header_rounds_up_with_floor(self):
+        assert retry_after_header(
+            SchedReject("shed", "a", 0.2))["Retry-After"] == "1"
+        assert retry_after_header(
+            SchedReject("rate_limited", "a", 2.3))["Retry-After"] == "3"
+
+
+# ------------------------------------------- SLOTracker out-of-order t
+class TestSLOTrackerOutOfOrder:
+    """record(t=...) feeds are clamped to the newest seen timestamp so
+    the per-window deques stay sorted and eviction stays exact —
+    replay feeds and multi-source clocks deliver out-of-order times."""
+
+    def make(self):
+        return SLOTracker(SLOConfig(windows=(10.0, 100.0),
+                                    fast_burn=0.0))
+
+    def test_late_old_timestamp_cannot_hide_behind_a_newer_one(self):
+        # state() evicts against the wall clock, so anchor there
+        base = time.time()
+        tr = self.make()
+        tr.record(False, t=base)
+        tr.record(False, t=base - 50.0)  # clamped up to base
+        win = tr.state()["windows"]["10s"]
+        assert (win["total"], win["bad"]) == (2, 2)
+        # a record past the window end evicts BOTH together — an
+        # unclamped base-50 entry sitting behind base would make the
+        # head-pop eviction stop early and overcount forever
+        tr.record(True, t=base + 11.0)
+        win = tr._state_locked(base + 11.0)["windows"]["10s"]
+        assert (win["total"], win["bad"]) == (1, 0)
+
+    def test_out_of_order_feed_matches_sorted_feed(self):
+        # the invariant in one line: counts equal a tracker fed the
+        # same outcomes with the clamped (sorted) timestamps
+        base = time.time()
+        shuffled = [(False, base + 3.0), (True, base + 1.0),
+                    (False, base + 2.5), (True, base + 4.0),
+                    (False, base + 1.2)]
+        a, b = self.make(), self.make()
+        for good, t in shuffled:
+            a.record(good, t=t)
+        clamped, hi = [], float("-inf")
+        for good, t in shuffled:
+            hi = max(hi, t)
+            clamped.append((good, hi))
+        for good, t in clamped:
+            b.record(good, t=t)
+        assert a.state()["windows"] == b.state()["windows"]
+
+    def test_high_water_mark_tracks_the_max(self):
+        base = time.time()
+        tr = self.make()
+        for dt in (5.0, 3.0, 9.0, 1.0):
+            tr.record(True, t=base + dt)
+        assert tr._last_t == base + 9.0
+
+
+# --------------------------------------------------- engine integration
+@pytest.fixture(scope="module")
+def glue():
+    jax = pytest.importorskip("jax")
+    del jax
+    from gofr_tpu.serving import glue as g
+    return g
+
+
+def _finish(reqs, timeout=120.0):
+    deadline = time.time() + timeout
+    while any(r.finished_at is None and r.error is None for r in reqs):
+        if time.time() > deadline:
+            raise TimeoutError("requests did not finish")
+        time.sleep(0.005)
+    return reqs
+
+
+class TestEngineIntegration:
+    def test_starvation_preempts_background_for_interactive(self, glue):
+        from gofr_tpu.serving.engine import EngineConfig, SamplingParams
+        cfg = EngineConfig(
+            max_batch=1, max_seq=128, seed=7,
+            scheduler=SchedulerConfig(starvation_s=0.05,
+                                      preempt_min_interval_s=0.0))
+        eng = glue.demo_llama_engine(cfg)
+        eng.start()
+        try:
+            bg = eng.submit([1, 2, 3, 4],
+                            SamplingParams(max_new_tokens=96,
+                                           temperature=0.0),
+                            tenant="bulk", lane=BACKGROUND)
+            deadline = time.time() + 30.0
+            while bg.slot < 0:  # wait until it holds the only slot
+                assert time.time() < deadline, bg.error
+                time.sleep(0.002)
+            fg = eng.submit([5, 6, 7],
+                            SamplingParams(max_new_tokens=4,
+                                           temperature=0.0),
+                            tenant="chat")
+            _finish([bg, fg])
+            assert fg.error is None and bg.error is None
+            assert eng.waiting.counters["preemptions"] >= 1
+            # the victim was recomputed, not lost
+            assert len(bg.generated) == 96
+            assert len(fg.generated) == 4
+            # the interactive request did not wait for the 96-token
+            # background request to finish first
+            assert fg.finished_at < bg.finished_at
+        finally:
+            eng.stop()
+
+
+# ------------------------------------------------------ replay evidence
+def contention_workload():
+    """Synthetic two-tenant contention capture: the hot tenant floods
+    8 long requests, then the victim submits 3 short ones. Greedy,
+    versioned, replayable — the records carry no completions (status
+    absent), so replay measures scheduling, not token identity."""
+    records = []
+    t = 0.0
+    for i in range(8):
+        records.append({"t": t, "tenant": "team-hot",
+                        "prompt_tokens": [1 + i, 2, 3, 4, 5, 6],
+                        "params": {"temperature": 0.0,
+                                   "max_new_tokens": 24}})
+        t += 0.001
+    for i in range(3):
+        records.append({"t": t, "tenant": "team-victim",
+                        "prompt_tokens": [9 + i, 8, 7],
+                        "params": {"temperature": 0.0,
+                                   "max_new_tokens": 4}})
+        t += 0.001
+    return {"header": {"format": WORKLOAD_FORMAT,
+                       "version": WORKLOAD_VERSION, "engine_seed": 3},
+            "records": records}
+
+
+def tenant_e2es(eng, tenant):
+    return [ev["e2e_s"] for ev in eng.usage_ledger._events
+            if ev["tenant"] == tenant and ev["status"] == "ok"]
+
+
+def burn_rate(e2es, threshold_s, availability=0.999):
+    """The SLO fast-burn arithmetic over one tenant's replayed
+    latencies: error rate over the window divided by the budget."""
+    bad = sum(1 for v in e2es if v > threshold_s)
+    return (bad / len(e2es)) / (1.0 - availability)
+
+
+class TestFairShareReplayEvidence:
+    """The acceptance evidence for this PR, as a test: the SAME
+    contention workload replayed under FIFO and under fair-share. The
+    victim tenant's burn rate must be STRICTLY lower under fair-share,
+    and the aggregate goodput ratio must degrade by at most 5% — the
+    policy buys isolation with queueing order, not with device waste.
+    """
+
+    def replay(self, glue, policy):
+        from gofr_tpu.serving.engine import (EngineConfig,
+                                             SamplingParams)
+        from gofr_tpu.serving.replay import replay_workload
+        workload = contention_workload()
+        cfg = EngineConfig(max_batch=1, max_seq=128,
+                           seed=workload["header"]["engine_seed"],
+                           scheduler=SchedulerConfig(policy=policy))
+        eng = glue.demo_llama_engine(cfg)
+        try:
+            # warm the jit caches first: otherwise compile time lands
+            # in the first request's e2e and drowns the queueing
+            # signal the comparison measures
+            eng.start()
+            _finish([eng.submit([1, 2, 3, 4, 5, 6],
+                                SamplingParams(max_new_tokens=24,
+                                               temperature=0.0),
+                                tenant="warmup"),
+                     eng.submit([1, 2, 3],
+                                SamplingParams(max_new_tokens=4,
+                                               temperature=0.0),
+                                tenant="warmup")])
+            report = replay_workload(eng, workload, speed=1000.0,
+                                     timeout_s=120.0)
+        finally:
+            eng.stop()
+        return eng, report
+
+    def test_victim_burn_lower_goodput_within_5pct(self, glue):
+        fifo_eng, fifo_rep = self.replay(glue, "fifo")
+        fair_eng, fair_rep = self.replay(glue, "fair")
+        assert fifo_rep["replay_errors"] == 0
+        assert fair_rep["replay_errors"] == 0
+
+        fifo_victim = tenant_e2es(fifo_eng, "team-victim")
+        fair_victim = tenant_e2es(fair_eng, "team-victim")
+        assert len(fifo_victim) == len(fair_victim) == 3
+
+        # under FIFO the victim queues behind the hot tenant's entire
+        # flood; under fair-share the DRR debt interleaves it after a
+        # single hot request. Judge both runs against the same
+        # threshold: half the BEST e2e the victim saw under FIFO.
+        threshold = 0.5 * min(fifo_victim)
+        fifo_burn = burn_rate(fifo_victim, threshold)
+        fair_burn = burn_rate(fair_victim, threshold)
+        assert fifo_burn > 0  # the contention is real
+        assert fair_burn < fifo_burn  # strictly lower, the tentpole
+        # and the isolation is mechanical, not marginal: the victim's
+        # worst wait under fair-share beats its best wait under FIFO
+        assert max(fair_victim) < min(fifo_victim)
+
+        # aggregate efficiency: fairness reorders the queue, it must
+        # not burn device time — goodput ratio within 5% of FIFO
+        fifo_ratio = fifo_rep["replayed_goodput"]["goodput_ratio"]
+        fair_ratio = fair_rep["replayed_goodput"]["goodput_ratio"]
+        assert fair_ratio >= 0.95 * fifo_ratio, (fifo_ratio, fair_ratio)
+
+        # the hot tenant still gets all its work done
+        assert len(tenant_e2es(fair_eng, "team-hot")) == 8
