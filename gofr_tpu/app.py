@@ -57,6 +57,12 @@ class App:
         self._ws_router: Router | None = None
         self._ws_services: dict[str, Any] = {}
         self._auth_providers: list[Any] = []  # also guard the WS upgrade
+        # serving lifecycle registry for the graceful SIGTERM drain:
+        # engines drain (admission closed, in-flight work finishes)
+        # and fleet agents deregister from their leader BEFORE the
+        # shutdown hooks hard-stop everything (_graceful_stop)
+        self._engines: list[Any] = []
+        self._agents: list[Any] = []
 
         self.http_port = self.config.get_int("HTTP_PORT", DEFAULT_HTTP_PORT) \
             if hasattr(self.config, "get_int") else DEFAULT_HTTP_PORT
@@ -316,6 +322,7 @@ class App:
         # a wedged device call must only hold it for close()'s short
         # join budget, not stop()'s full 30s
         self.on_shutdown(engine.close)
+        self._engines.append(engine)
 
     # ------------------------------------------------------------- fleet
     def serve_fleet_leader(self, *, coordinator: str = "",
@@ -358,6 +365,7 @@ class App:
                             logger=self.logger, **{**sources, **kw})
         self.on_start(lambda c: agent.start())
         self.on_shutdown(agent.stop)
+        self._agents.append(agent)
         return agent
 
     def _install_debug_routes(self) -> None:
@@ -793,12 +801,49 @@ class App:
         self._shutdown_task = asyncio.ensure_future(self._graceful_stop())
 
     async def _graceful_stop(self) -> None:
+        deadline = time.monotonic() + self.shutdown_grace
         try:
-            await asyncio.wait_for(self.stop(), self.shutdown_grace)
+            await asyncio.wait_for(self._drain_serving(deadline),
+                                   self.shutdown_grace)
+        except asyncio.TimeoutError:
+            self.logger.error("serving drain timed out; stopping hard")
+        except Exception as exc:  # drain is best-effort by contract
+            self.logger.warn(f"serving drain failed: {exc!r}")
+        try:
+            await asyncio.wait_for(
+                self.stop(), max(1.0, deadline - time.monotonic()))
         except asyncio.TimeoutError:
             self.logger.error("graceful shutdown timed out; forcing exit")
             if self._stop_event is not None:
                 self._stop_event.set()
+
+    async def _drain_serving(self, deadline: float) -> None:
+        """SIGTERM drain, in dependency order and inside the grace
+        budget: (1) every served engine drains — admission closes (new
+        submits get a typed 503 + Retry-After), queued and in-flight
+        requests run to completion; (2) fleet agents deregister from
+        their leader so survivors re-rank NOW instead of waiting out
+        heartbeat silence. Engines drain concurrently on worker
+        threads (``Engine.drain`` blocks); half the remaining grace is
+        reserved for the hard-stop hooks that follow."""
+        drainable = [e for e in self._engines if hasattr(e, "drain")]
+        if drainable:
+            budget = max(0.5, (deadline - time.monotonic()) * 0.5)
+            self.logger.info(
+                f"draining {len(drainable)} engine(s), budget "
+                f"{budget:.1f}s")
+            results = await asyncio.gather(
+                *(asyncio.to_thread(e.drain, budget) for e in drainable),
+                return_exceptions=True)
+            for engine, ok in zip(drainable, results):
+                if ok is not True:
+                    self.logger.warn(
+                        "engine did not drain cleanly",
+                        detail=repr(ok) if isinstance(ok, Exception)
+                        else "stragglers cut off at the deadline")
+        for agent in self._agents:
+            if hasattr(agent, "deregister"):
+                await asyncio.to_thread(agent.deregister)
 
     def run(self) -> None:
         """Blocking entry point (reference run.go:15)."""

@@ -68,6 +68,7 @@ arithmetic, and the stall watchdog polls ``health_check()``.
 
 from __future__ import annotations
 
+import random
 import statistics
 import threading
 import time
@@ -77,6 +78,7 @@ from typing import Any, Callable, Mapping
 from ..http.errors import ErrorInvalidParam, HTTPError
 from ..logging.logger import set_fleet_context
 from ..metrics.registry import merge_snapshots, render_federated
+from .faults import NO_FAULTS, resolve_plan
 
 
 class StaleGeneration(HTTPError):
@@ -115,7 +117,8 @@ def engine_fleet_sources(engine: Any) -> tuple[Callable[[], dict],
     def health() -> dict:
         h = engine.health_check()
         out = {"status": h.get("status", "UP")}
-        for key in ("error", "stalled_for_s", "stalls"):
+        for key in ("error", "stalled_for_s", "stalls", "restarts",
+                    "last_crash", "stranded_slots"):
             if key in h:
                 out[key] = h[key]
         return out
@@ -611,6 +614,18 @@ class ControlPlaneLeader:
                     "generation": assignment.generation,
                     "assignment": assignment.to_dict()}
 
+        @app.post("/control/leave")
+        def leave(ctx):
+            # graceful deregistration (SIGTERM drain): the departing
+            # worker tells the leader NOW instead of making survivors
+            # wait out heartbeat silence before re-ranking
+            body = ctx.bind() or {}
+            host_id = str(body.get("host_id", ""))
+            if not host_id:
+                raise ErrorInvalidParam("host_id")
+            self.evict(host_id, reason="leave")
+            return {"ok": True, "generation": self.generation}
+
         @app.get("/control/topology")
         def topology(ctx):
             return self.topology()
@@ -650,13 +665,22 @@ class WorkerAgent:
                  summary_source: Callable[[], dict] | None = None,
                  metrics_source: Callable[[], dict | None] | None = None,
                  fleet: FleetConfig | None = None,
+                 join_backoff_max_s: float = 30.0,
                  tracer: Any = None,
-                 logger: Any = None, service: Any = None) -> None:
+                 logger: Any = None, service: Any = None,
+                 faults: Any = None) -> None:
         from ..service import CircuitBreaker, Retry, new_http_service
         self.host_id = host_id
         self.address = address
         self.n_devices = n_devices
         self.heartbeat_interval_s = heartbeat_interval_s
+        #: join-retry backoff ceiling (exponential from the heartbeat
+        #: interval, full jitter — see start()'s run loop)
+        self.join_backoff_max_s = join_backoff_max_s
+        #: deterministic fault plan (serving/faults.py) for the
+        #: control-plane sites heartbeat_drop / join_refused; None
+        #: reads GOFR_FAULTS, unset -> the NO_FAULTS singleton
+        self.faults = resolve_plan(faults)
         self.on_assignment = on_assignment
         self.health_source = health_source or (lambda: {"status": "UP"})
         #: flight-recorder digest attached to every heartbeat (None =
@@ -673,6 +697,7 @@ class WorkerAgent:
                              logger=logger, tracer=tracer)
         self.assignment: ShardAssignment | None = None
         self._running = False
+        self._leaving = False  # deregistered: suppress auto-rejoin
         self._thread: threading.Thread | None = None
 
     # ------------------------------------------------------------- wire
@@ -750,6 +775,10 @@ class WorkerAgent:
             return True  # a broken probe must not strand the agent
 
     def join(self) -> ShardAssignment:
+        if self.faults is not NO_FAULTS \
+                and self.faults.trip("join_refused"):
+            # injected leader refusal: exercises the join-retry backoff
+            raise RuntimeError("control-plane join refused (injected)")
         payload = self._post("/control/join", {
             "host_id": self.host_id, "address": self.address,
             "n_devices": self.n_devices,
@@ -770,6 +799,9 @@ class WorkerAgent:
         return self.assignment, after != before
 
     def _heartbeat_once(self) -> None:
+        if self.faults is not NO_FAULTS \
+                and self.faults.trip("heartbeat_drop"):
+            return  # injected lossy control network: skip this beat
         generation = (self.assignment.generation
                       if self.assignment is not None else -1)
         body: dict[str, Any] = {
@@ -821,6 +853,7 @@ class WorkerAgent:
         the thread keeps retrying the join with backoff until it
         lands, then heartbeats."""
         self._running = True
+        self._leaving = False
         try:
             self.join()
         except Exception as exc:
@@ -829,24 +862,62 @@ class WorkerAgent:
                     f"control-plane join failed, will retry: {exc}")
 
         def run() -> None:
+            # Unassigned (leader down, evicted, join refused): retries
+            # back off exponentially from the heartbeat interval up to
+            # join_backoff_max_s, with FULL jitter (x0.5-1.5) — a
+            # restarting leader must not be met by every worker's join
+            # landing on the same heartbeat tick (thundering herd). A
+            # successful join — or simply being assigned — resets the
+            # backoff; assigned heartbeats keep the fixed cadence.
+            base = max(0.01, self.heartbeat_interval_s)
+            backoff = base
             while self._running:
-                time.sleep(self.heartbeat_interval_s)
+                if self.assignment is not None:
+                    delay = base
+                else:
+                    delay = backoff * (0.5 + random.random())
+                time.sleep(delay)
                 if not self._running:
                     return
                 if self.assignment is None:
+                    if self._leaving:
+                        continue  # deregistered: awaiting stop()
                     if not self._healthy():
                         continue  # evicted-degraded: heal first
                     try:
                         self.join()
+                        backoff = base
                     except Exception as exc:
+                        backoff = min(backoff * 2.0,
+                                      self.join_backoff_max_s)
                         if self.logger:
-                            self.logger.warn(f"join retry failed: {exc}")
+                            self.logger.warn(
+                                f"join retry failed: {exc}; next "
+                                f"attempt in <= {backoff * 1.5:.1f}s")
                 else:
+                    backoff = base
                     self._heartbeat_once()
 
         self._thread = threading.Thread(target=run, daemon=True,
                                         name=f"worker-{self.host_id}")
         self._thread.start()
+
+    def deregister(self) -> None:
+        """Graceful leave (the SIGTERM drain path): tell the leader
+        this host is going away NOW — survivors re-rank immediately
+        instead of waiting out heartbeat silence. Best-effort: a dead
+        leader must never block shutdown. Clears the assignment so the
+        heartbeat thread does not immediately rejoin."""
+        self._leaving = True
+        self.assignment = None
+        try:
+            self._post("/control/leave", {"host_id": self.host_id})
+            if self.logger:
+                self.logger.info("deregistered from serving group",
+                                 host=self.host_id)
+        except Exception as exc:
+            if self.logger:
+                self.logger.warn(f"control-plane leave failed: {exc}")
 
     def stop(self) -> None:
         self._running = False

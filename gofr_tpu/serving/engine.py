@@ -51,6 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..analysis.annotations import hot_path, hot_path_boundary
+from .faults import NO_FAULTS, resolve_plan
 
 NEG_INF = -1e30
 
@@ -122,6 +123,11 @@ class GenRequest:
                                    # admission refused the request —
                                    # handlers turn it into 429/503 with
                                    # Retry-After instead of a blanket 503
+    recovered: bool = False        # salvaged across an engine restart
+                                   # before its first token: the replay
+                                   # prefill recomputes KV it already
+                                   # paid for once, priced under the
+                                   # preempt_recompute goodput cause
 
     def _emit(self, token: int | None) -> None:
         if self.out_queue is not None and self.loop is not None:
@@ -140,6 +146,26 @@ class GenRequest:
         if self.first_token_at is None:
             return None
         return (self.first_token_at - self.submitted_at) * 1000.0
+
+
+@dataclass
+class RestartPolicy:
+    """Crash-recovery budget for the in-thread engine supervisor: on a
+    hot-loop exception the loop salvages what it safely can (see
+    ``Engine._recover``), rebuilds runtime state on the resident
+    weights and compiled graphs, sleeps a deterministic exponential
+    backoff, and resumes — up to ``max_restarts`` times, after which
+    the crash is terminal (health DOWN, the old ``_crash`` semantics).
+    """
+    max_restarts: int = 3       # lifetime restart budget; 0 = disabled
+    backoff_s: float = 0.05     # sleep before restart #1
+    backoff_mult: float = 2.0   # growth per successive restart
+    max_backoff_s: float = 5.0  # backoff ceiling
+
+    def backoff_for(self, attempt: int) -> float:
+        """Deterministic backoff before restart ``attempt`` (1-based)."""
+        return min(self.max_backoff_s,
+                   self.backoff_s * self.backoff_mult ** max(0, attempt - 1))
 
 
 @dataclass
@@ -321,6 +347,21 @@ class EngineConfig:
     #: default SchedulerConfig (fair-share ON — single-tenant traffic
     #: is strict FIFO, bit-identical to the old queue).
     scheduler: Any = None
+    #: deterministic fault injection (serving/faults.py): a FaultPlan,
+    #: a plan string ("pass_raise:at=3;..."), or None = read the
+    #: ``GOFR_FAULTS`` env (unset -> the NO_FAULTS no-op singleton).
+    #: Sites are compiled into the hot loop behind an identity
+    #: comparison against NO_FAULTS, so the disabled default costs
+    #: nothing and transfer-guard/bit-identity invariants hold.
+    faults: Any = None
+    #: crash recovery: a RestartPolicy arms the in-thread supervisor —
+    #: a hot-loop exception salvages pre-first-token requests into the
+    #: recovery buffer, fails mid-stream ones with a typed retryable
+    #: error, rebuilds runtime state on the resident weights/compile
+    #: cache and resumes after a deterministic backoff. None (default)
+    #: keeps the historical fail-fast semantics: any loop exception is
+    #: terminal (health DOWN).
+    restart_policy: Any = None
 
 
 class Engine:
@@ -628,6 +669,15 @@ class Engine:
         self._failed: str | None = None
         self._last_beat = time.time()
         self._watchdog: Any = None  # StallWatchdog, started with start()
+        #: deterministic fault plan; the disabled default IS the
+        #: NO_FAULTS singleton, so every site guards with one identity
+        #: comparison (``self.faults is not NO_FAULTS``)
+        self.faults = resolve_plan(config.faults)
+        # crash-recovery supervisor state (see _recover / RestartPolicy)
+        self._restarts = 0
+        self._last_crash: str | None = None
+        self._stranded_slots = 0   # active slots a timed-out stop() left
+        self._draining = False     # drain(): admission closed, work runs
 
         # admission queue: the tenant/SLO-aware Scheduler (same
         # put/pop_batch/qsize/close contract as native/batch_queue) —
@@ -752,8 +802,32 @@ class Engine:
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> None:
+        """Start (or RESTART) the engine thread. An engine stopped with
+        ``stop()``/``drain()`` restarts in place: weights and every
+        compiled graph are still resident, so the restart skips
+        warmup entirely — only KV bookkeeping and the admission queue
+        reset (the queue reopens; tenant/rate-limit state survives)."""
         if self._running:
             return
+        prev = self._thread
+        if prev is not None and prev.is_alive():
+            # a timed-out stop() left the old loop mid device call; a
+            # second loop over the same donated caches would corrupt
+            # them — the caller must wait the pass out first
+            raise RuntimeError(
+                "previous engine thread is still in a device call "
+                "(stop() timed out); wait for it to exit before start()")
+        if self._cleaned:
+            # restart after a clean stop (or a terminal crash): stand
+            # the runtime back up on the resident weights/compile cache
+            self._reset_runtime_state()
+            self._cleaned = False
+            self._failed = None
+            self._stranded_slots = 0
+            if hasattr(self.waiting, "reopen"):
+                self.waiting.reopen()
+        self._draining = False
+        self._last_beat = time.time()
         self._running = True
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="gofr-engine")
@@ -789,10 +863,14 @@ class Engine:
                 # can never see tokens after its terminal None. The
                 # thread handle stays set so repeated stop()/close()
                 # never run the full cleanup concurrently with it.
+                stranded_active = sum(
+                    1 for r in self.active if r is not None)
+                self._stranded_slots = stranded_active
                 if self.logger:
                     self.logger.warn(
-                        "engine thread still in a device call; streams "
-                        "retire when the pass completes")
+                        f"engine thread still in a device call; "
+                        f"{stranded_active} active slot(s) stranded — "
+                        "streams retire when the pass completes")
                 self.waiting.close()
                 stranded = self.waiting.pop_batch(1 << 16, first_wait_s=0.0)
                 for req in stranded or []:
@@ -801,6 +879,35 @@ class Engine:
             self._thread = None
         if not self._cleaned:  # loop never started (or crashed mid-start)
             self._shutdown_cleanup("engine stopped")
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful shutdown: close admission (new submits are refused
+        with a typed ``draining`` 503 + Retry-After), let queued and
+        in-flight requests run to completion, then ``stop()``. Returns
+        True when everything retired inside the budget; False when the
+        deadline cut stragglers off (they fail with "engine stopped",
+        like a plain stop). The engine can ``start()`` again after."""
+        deadline = time.time() + timeout_s
+        self._draining = True
+        try:
+            drained = False
+            while True:
+                # engine-thread-owned state read racily from here: all
+                # plain loads under the GIL, and the quiesce condition
+                # is stable once reached (admission is closed)
+                if (self.waiting.qsize() == 0 and not self._requeued
+                        and not self._pending
+                        and not self._pending_prefills
+                        and all(r is None for r in self.active)):
+                    drained = True
+                    break
+                if not self._running or time.time() >= deadline:
+                    break
+                time.sleep(0.01)
+            self.stop(join_timeout_s=max(1.0, deadline - time.time()))
+            return drained and not self._stranded_slots
+        finally:
+            self._draining = False
 
     def _shutdown_cleanup(self, reason: str) -> None:
         """Terminal teardown: refuse new submissions, fail anything
@@ -821,6 +928,42 @@ class Engine:
                 self.active[i] = None
                 self.lengths[i] = 0
                 self._fail(req, reason)
+
+    def _reset_runtime_state(self) -> None:
+        """Stand the runtime back up on the resident weights: no
+        in-flight passes, empty KV bookkeeping, a pristine paged
+        allocator, device scheduler state marked for re-upload.
+        Weights and every compiled graph are untouched — a restarted
+        engine serves its first request without recompiling. Shared by
+        ``start()``-after-``stop()`` and the crash-recovery supervisor
+        (``_recover``); donated caches are re-allocated only when a
+        crashing pass actually consumed them."""
+        cfg = self.config
+        self._pending.clear()
+        self._pending_prefills.clear()
+        self._dev_last = None
+        self._dev_last_reqs = [None] * cfg.max_batch
+        self._dev_sched = None
+        self._sched_dirty = True
+        self._tables_dirty = True
+        self._decode_busy_until = 0.0
+        self._prefill_busy_until = 0.0
+        lost = self.k_cache.is_deleted() or self.v_cache.is_deleted()
+        if cfg.kv_layout == "paged":
+            if lost:
+                self.k_cache, self.v_cache = self._alloc_pool(
+                    max(1, int(cfg.page_size)))
+            self._free_pages = list(range(self._n_pages))
+            self._tables[:] = self._n_pages
+            self._slot_pages[:] = 0
+            self._page_refs[:] = 0
+            self._prefix_cache.clear()
+            self._prefix_lens.clear()
+            self._cached_pages = 0
+        elif lost:
+            self.k_cache, self.v_cache = self._make_cache(
+                cfg.max_batch, cfg.max_seq)
+        self.lengths[:] = 0
 
     def health_check(self) -> dict:
         status = "DOWN" if (self._failed or not self._running) else "UP"
@@ -844,6 +987,12 @@ class Engine:
             out["stalled_for_s"] = round(stalled_for, 1)
         if self.stats.get("stalls"):
             out["stalls"] = self.stats["stalls"]
+        if self._restarts:
+            out["restarts"] = self._restarts
+        if self._last_crash:
+            out["last_crash"] = self._last_crash
+        if self._stranded_slots:
+            out["stranded_slots"] = self._stranded_slots
         if self._failed:
             out["error"] = self._failed
         if self.recorder.enabled:
@@ -935,6 +1084,12 @@ class Engine:
             ("app_engine_recompiles",
              "unexpected post-warmup XLA recompiles detected by the "
              "dispatch-shape sentinel"),
+            ("app_engine_restarts",
+             "engine loop restarts by the in-thread crash-recovery "
+             "supervisor (EngineConfig.restart_policy)"),
+            ("app_engine_requests_recovered",
+             "pre-first-token requests salvaged into the recovery "
+             "buffer and replayed across an engine restart"),
         ):
             if metrics.get(name) is None:
                 metrics.new_counter(name, desc)
@@ -1168,16 +1323,41 @@ class Engine:
         except RuntimeError:  # submitted from a plain thread (tests/bench)
             req.loop = None
             req.out_queue = None
+        if self.faults is not NO_FAULTS \
+                and self.faults.trip("page_exhaustion",
+                                     request_id=req.tenant):
+            # injected KV-pool exhaustion: refused at admission with a
+            # typed retryable 503 — the engine keeps serving
+            self._refuse(req, "kv_exhausted",
+                         "kv page pool exhausted; retry shortly",
+                         retry_after_s=1.0)
+            return req
+        if self._draining:
+            self._refuse(req, "draining",
+                         "engine draining for shutdown; retry against "
+                         "another replica", retry_after_s=5.0)
+            return req
         if not self.waiting.put(req):  # refused/closed: fail loudly,
             # never hang. The scheduler stamps a typed reject
             # (queue_full / rate_limited / shed) for policy refusals;
-            # a closed queue stamps nothing.
+            # a closed queue stamps nothing — lifecycle refusals
+            # (stopped or crashed engine) get their own typed code so
+            # clients see 503 + Retry-After + details.code, not a bare
+            # string.
             if req.reject is not None and self._running:
                 self._fail(req, req.reject.message)
+            elif self._running:
+                self._fail(req, "engine overloaded: waiting queue full")
             else:
-                self._fail(req, "engine overloaded: waiting queue full"
-                           if self._running else
-                           "engine not accepting requests")
+                policy = self.config.restart_policy
+                retry = (policy.backoff_for(self._restarts + 1)
+                         if policy is not None else 1.0)
+                self._refuse(
+                    req, "engine_down",
+                    "engine not accepting requests"
+                    + (f" (last crash: {self._last_crash})"
+                       if self._last_crash else ""),
+                    retry_after_s=max(1.0, retry))
         return req
 
     def submit_sync(self, prompt_tokens: list[int],
@@ -1552,14 +1732,15 @@ class Engine:
                         # emitted is re-prefilling KV it computed once
                         # (preemption recompute); pad rows are padding
                         recomp = sum(1 for r in ready
-                                     if r.first_token_at is not None)
+                                     if r.first_token_at is not None
+                                     or r.recovered)
                         self.goodput.add_prefill(
                             "prefill_chunk", c_dur, G,
                             len(ready) - recomp, recomp)
                         w1 = time.time()  # gofrlint: allow(hot-path-purity) -- span timestamps use wall clock; once per chunk dispatch
                         for r in ready:
                             r.device_s += c_dur / len(ready)
-                            if r.first_token_at is not None:
+                            if r.first_token_at is not None or r.recovered:
                                 r.waste_recompute_s += c_dur / len(ready)
                             self._req_event(
                                 r, "prefill", w0, w1,
@@ -2013,6 +2194,23 @@ class Engine:
         self._finalize_obs(req)
         req._emit(None)
 
+    @hot_path_boundary(
+        "lifecycle refusal path (drain/crash window), not steady-state")
+    def _refuse(self, req: GenRequest, code: str, detail: str, *,
+                retry_after_s: float = 1.0) -> None:
+        """Fail ``req`` with a typed, machine-readable reject — the
+        same :class:`~.scheduler.SchedReject` shape the scheduler
+        stamps for policy refusals, so the handlers' structured-error
+        path (503 + ``Retry-After`` + ``details.code``, OpenAI-compat
+        included) covers lifecycle refusals (drain, crash window, KV
+        exhaustion) too. Typed rejects are policy, not service
+        failures: ``_finalize_obs`` keeps them out of the SLO burn."""
+        from .scheduler import SchedReject
+        req.reject = SchedReject(code=code, tenant=req.tenant,
+                                 retry_after_s=retry_after_s,
+                                 detail=detail)
+        self._fail(req, req.reject.message)
+
     def _admit_batch(self, reqs: list[GenRequest]) -> None:
         """Admit a burst: group by prompt bucket, prefill each group in
         chunks of ``prefill_batch`` with one device call per chunk.
@@ -2237,9 +2435,10 @@ class Engine:
                     continue
                 req.pending_prefill = False
                 req.device_s += pass_share
-                if req.first_token_at is not None:
+                if req.first_token_at is not None or req.recovered:
                     # a recompute row: the KV it just prefilled was
-                    # already computed in its pre-preemption life
+                    # already computed in its pre-preemption (or
+                    # pre-restart) life
                     recompute_rows += 1
                     req.waste_recompute_s += pass_share
                 else:
@@ -2563,6 +2762,11 @@ class Engine:
         since dispatch are discarded (their rows decoded garbage)."""
         if not self._pending:
             return
+        if self.faults is not NO_FAULTS:
+            # corrupt-pass injection: a pass HAS dispatched, so tokens
+            # are in flight — recovery must take the mid-stream
+            # typed-retryable branch, never the bit-identical replay
+            self.faults.trip("nan_logits")
         rec = self._pending.popleft()
         step_np = np.asarray(rec["toks"])  # [T, B] — blocks on device  # gofrlint: allow(hot-path-purity) -- this sync IS the decode collect: the token download is the pass's one sanctioned device read
         # decode_s = wall time with a decode pass in flight (dispatch →
@@ -2983,6 +3187,15 @@ class Engine:
         try:
             while self._running:
                 self._last_beat = time.time()
+                if self.faults is not NO_FAULTS:
+                    # deterministic chaos (serving/faults.py), armed
+                    # only when a plan is loaded: pass_raise throws
+                    # into the recovery path below, pass_stall /
+                    # pass_latency wedge the loop so the watchdog and
+                    # the control plane see a genuine stall
+                    self.faults.trip("pass_raise")
+                    self.faults.trip("pass_stall")
+                    self.faults.trip("pass_latency")
                 free = sum(1 for r in self.active if r is None)
                 busy = free < self.config.max_batch
                 if free == 0 and not self._requeued:
@@ -3070,9 +3283,97 @@ class Engine:
             self._drain_pending()
             self._collect_prefills()
         except Exception as exc:  # containment: never die silently
-            self._crash(exc)
+            if self._recover(exc):
+                # runtime state rebuilt on the resident weights and
+                # compile cache: resume serving and replay the recovery
+                # buffer. Recursion depth is bounded by
+                # restart_policy.max_restarts.
+                self._loop()
+            else:
+                self._crash(exc)
         else:
             self._shutdown_cleanup("engine stopped")
+
+    def _recover(self, exc: BaseException) -> bool:
+        """In-thread crash-recovery supervisor: when
+        ``config.restart_policy`` has budget left, salvage what can be
+        salvaged, rebuild the runtime on the resident weights and
+        compiled graphs, sleep a deterministic exponential backoff and
+        report True so ``_loop`` resumes. False = no policy, budget
+        exhausted, or the engine was stopping anyway — the crash is
+        terminal (``_crash``).
+
+        Salvage rules (the no-duplicate-token invariant): a request
+        that has NOT emitted its first token replays invisibly — it
+        goes to the recovery buffer (the ``_requeued`` fast lane, which
+        bypasses the admission bound) and re-prefills from its prompt,
+        priced as ``preempt_recompute`` waste via the ``recovered``
+        flag. A MID-STREAM request already holds tokens the engine
+        cannot un-send, so replaying it risks duplicates — it fails
+        with a typed retryable ``engine_restart`` reject (503 +
+        Retry-After + details.code through the handlers)."""
+        policy = self.config.restart_policy
+        if (policy is None or not self._running
+                or self._restarts >= policy.max_restarts):
+            return False
+        self._restarts += 1
+        self._last_crash = f"{type(exc).__name__}: {exc}"
+        backoff = policy.backoff_for(self._restarts)
+        if self.logger:
+            self.logger.error(
+                f"engine loop crashed ({self._last_crash}); restarting "
+                f"{self._restarts}/{policy.max_restarts} after "
+                f"{backoff:.2f}s backoff")
+            self.recorder.dump(self.logger, reason=self._last_crash)
+        if self.metrics is not None:
+            self.metrics.increment_counter("app_engine_restarts")
+        from .scheduler import SchedReject
+        recovered = 0
+        for i, req in enumerate(self.active):
+            if req is None:
+                continue
+            self.active[i] = None
+            self.lengths[i] = 0
+            if req.finished_at is not None:
+                continue
+            if req.cancelled:  # consumer gone: just close the stream
+                req.finished_at = time.time()
+                self._finalize_obs(req)
+                req._emit(None)
+            elif req.first_token_at is None:
+                req.pending_prefill = False
+                req.prefill_epoch += 1
+                req.prefill_offset = 0
+                req.slot = -1
+                req.recovered = True
+                self._requeue(req)
+                recovered += 1
+            else:
+                req.reject = SchedReject(
+                    code="engine_restart", tenant=req.tenant,
+                    retry_after_s=max(1.0, backoff),
+                    detail="engine restarted mid-stream; the partial "
+                           "output is stale — retry the request")
+                self._fail(req, req.reject.message)
+        # dispatched-but-uncollected passes died with the crash; the
+        # recovery buffer (_requeued) survives untouched and replays
+        # first once the loop resumes
+        self._reset_runtime_state()
+        if recovered:
+            if self.metrics is not None:
+                self.metrics.add_counter("app_engine_requests_recovered",
+                                         float(recovered))
+            if self.logger:
+                self.logger.warn(
+                    f"recovery buffer: {recovered} request(s) replay "
+                    "after restart")
+        deadline = time.time() + backoff
+        while self._running and time.time() < deadline:
+            # interruptible backoff: stop() during the sleep resumes
+            # the loop, which then exits through the CLEAN path
+            time.sleep(min(0.05, max(0.0, deadline - time.time())))
+        self._last_beat = time.time()
+        return True
 
     def _crash(self, exc: BaseException) -> None:
         """The hot loop threw: fail every in-flight request, refuse new

@@ -575,6 +575,14 @@ class Scheduler:
             self._closed = True
             self._lock.notify_all()
 
+    def reopen(self) -> None:
+        """Reverse :meth:`close` — the restartable-engine lifecycle:
+        ``Engine.start()`` after ``stop()`` reopens admission on the
+        same scheduler, keeping tenant state, counters and rate-bucket
+        levels (a restart is not an amnesty)."""
+        with self._lock:
+            self._closed = False
+
     # ------------------------------------------------------- starvation
     def starving_interactive(self) -> bool:
         """True when the engine should preempt a background slot: the

@@ -1,0 +1,22 @@
+"""hot-path-purity: fault injection inlined in the hot loop — the
+anti-pattern serving/faults.py exists to prevent. Lines matter —
+test_analysis.py pins them."""
+import time
+
+from gofr_tpu.analysis import hot_path
+
+
+class Engine:
+    @hot_path
+    def step(self, batch):
+        # ad-hoc chaos: trigger state off the wall clock, telemetry
+        # written from the dispatch path
+        if time.time() > self.fault_deadline:                   # L14
+            self.metrics.increment_counter("app_faults_fired")  # L15
+            self.logger.warn("injected fault firing")           # L16
+            raise RuntimeError("injected fault")
+        return self._advance(batch)
+
+    def _advance(self, batch):
+        # undecorated helper on the closure: its clock read flags too
+        return batch, time.time()                               # L22

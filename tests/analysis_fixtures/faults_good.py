@@ -1,0 +1,33 @@
+"""hot-path-purity: the clean twin — deterministic fault sites behind
+the NO_FAULTS identity guard and a @hot_path_boundary trip (the
+serving/faults.py pattern). None of this may be flagged."""
+import time
+
+from gofr_tpu.analysis import hot_path, hot_path_boundary
+
+
+class FaultPlan:
+    @hot_path_boundary("fault injection: when a plan is armed, firing "
+                       "the fault IS the point — the disabled default "
+                       "never reaches this method")
+    def trip(self, site):
+        # inside the boundary anything goes — this models FaultPlan.trip
+        self.fired[site] = self.fired.get(site, 0) + 1
+        self.logger.warn("injected fault firing", site=site)
+        time.sleep(self.seconds)
+        return True
+
+
+NO_FAULTS = FaultPlan()
+
+
+class Engine:
+    @hot_path
+    def step(self, batch):
+        # the compiled-in site: one identity comparison when disabled
+        if self.faults is not NO_FAULTS:
+            self.faults.trip("pass_raise")
+        return self._advance(batch)
+
+    def _advance(self, batch):
+        return batch

@@ -96,6 +96,30 @@ class TestSchedulerHotPathContract:
         assert not offenders, offenders
 
 
+# ---------------------------------------------------- fault-site contract
+class TestFaultInjectionSites:
+    """The serving/faults.py contract, lint-enforced: chaos compiled
+    into the hot loop is legal ONLY as a guarded call into a
+    @hot_path_boundary trip — inlined clocks/metrics/logging flag."""
+
+    def test_inline_chaos_flags(self):
+        got = violations(lint("faults_bad.py"), "hot-path-purity")
+        lines = {f.line for f in got}
+        assert {14, 15, 16} <= lines          # inline trigger + telemetry
+        assert 22 in lines                    # closure-reached helper
+
+    def test_boundary_guarded_sites_are_clean(self):
+        assert violations(lint("faults_good.py"), "hot-path-purity") == []
+
+    def test_live_trip_declares_a_boundary(self):
+        # the real module, not a fixture: FaultPlan.trip must keep its
+        # boundary (with a reason) or every compiled-in site would
+        # drag sleeps and counters into the engine's hot closure
+        from gofr_tpu.serving.faults import FaultPlan
+        reason = getattr(FaultPlan.trip, "__gofr_hot_path_boundary__", "")
+        assert isinstance(reason, str) and reason.strip()
+
+
 # ---------------------------------------------------------------- locks
 class TestLockDiscipline:
     def test_bad_fixture(self):
