@@ -326,18 +326,39 @@ class App:
 
     # ------------------------------------------------------------- fleet
     def serve_fleet_leader(self, *, coordinator: str = "",
-                           host_id: str = "leader", **kw):
+                           host_id: str = "leader", router=None,
+                           tokenizer=None, **kw):
         """Install a multi-host control-plane LEADER on this app:
         join/heartbeat/topology routes, the federated
         ``/control/fleet/metrics`` Prometheus surface and the
         consolidated ``/debug/fleet`` JSON view, wired to the
         container's logger and metrics manager. Returns the
-        :class:`~gofr_tpu.serving.control_plane.ControlPlaneLeader`."""
+        :class:`~gofr_tpu.serving.control_plane.ControlPlaneLeader`.
+
+        ``router=RouterConfig(...)`` additionally turns the leader
+        into the fleet's data-plane front door: ``POST /chat`` and the
+        OpenAI surface proxy to the member whose prefix cache best
+        covers the request, with session affinity, typed-reject
+        failover and unbuffered stream passthrough
+        (:class:`~gofr_tpu.serving.router.FleetRouter`, reachable
+        afterwards as ``leader.router``). ``tokenizer`` overrides the
+        routing tokenizer (default byte-level — correct whenever the
+        workers serve byte-tokenized models)."""
         from .serving.control_plane import ControlPlaneLeader
         leader = ControlPlaneLeader(coordinator=coordinator,
                                     host_id=host_id,
                                     logger=self.logger, **kw)
         leader.install(self)
+        leader.router = None
+        if router is not None:
+            from .serving.router import FleetRouter, RouterConfig
+            if router is True:
+                router = RouterConfig()
+            fleet_router = FleetRouter(leader, router,
+                                       tokenizer=tokenizer,
+                                       logger=self.logger)
+            fleet_router.install(self)
+            leader.router = fleet_router
         return leader
 
     def join_fleet(self, leader_url: str, *, host_id: str,
@@ -348,11 +369,24 @@ class App:
         ``traceparent`` on every control RPC, and sets the fleet
         context (host_id/rank/generation) that enriches every log
         record and span. ``engine=None`` picks the first served model.
-        Starts with the app, stops with it."""
+        An empty ``address`` advertises this app's own HTTP endpoint
+        (``ADVERTISE_HOST``, default 127.0.0.1, plus the bound port)
+        once the server binds — ephemeral-port workers become routable
+        by the leader's data-plane router without knowing their port
+        up front. Starts with the app, stops with it."""
         from .serving.control_plane import (WorkerAgent,
                                             engine_fleet_sources)
         if engine is None and self.container.models:
             engine = next(iter(self.container.models.values()))
+        addr_source: Any = address
+        if not address:
+            advertise_host = self.config.get("ADVERTISE_HOST") \
+                or "127.0.0.1"
+
+            def addr_source() -> str:
+                server = getattr(self, "http_server", None)
+                port = int(getattr(server, "bound_port", 0) or 0)
+                return f"{advertise_host}:{port}" if port else ""
         sources: dict = {}
         if engine is not None:
             health, summary, _metrics = engine_fleet_sources(engine)
@@ -360,7 +394,7 @@ class App:
                        "summary_source": summary}
         kw.setdefault("metrics_source", self.container.metrics.snapshot)
         agent = WorkerAgent(leader_url, host_id=host_id,
-                            address=address,
+                            address=addr_source,
                             tracer=self.container.tracer,
                             logger=self.logger, **{**sources, **kw})
         self.on_start(lambda c: agent.start())
@@ -479,11 +513,16 @@ class App:
             """Admission-scheduler state per served model: policy,
             lane depths, per-tenant shares/weights/burn, token-bucket
             levels, shed-episode state and the rejection counters —
-            the overload runbook's first stop (docs/operations.md)."""
+            the overload runbook's first stop (docs/operations.md).
+            ``?fresh=1`` forces a ledger-share refresh so the view
+            reflects retires that landed inside the 0.5s share-cache
+            window (smokes and operators mid-incident want truth,
+            not a cheap read)."""
+            fresh = ctx.param("fresh") in ("1", "true")
             out = {}
             for model_name, engine in container.models.items():
                 sched = getattr(engine, "waiting", None)
-                out[model_name] = sched.state() \
+                out[model_name] = sched.state(fresh=fresh) \
                     if hasattr(sched, "state") else None
             return out
         self.get("/debug/scheduler", scheduler_debug)

@@ -302,6 +302,27 @@ class Container:
         m.new_counter("app_sched_preemptions",
                       "scheduler-initiated background preemptions to "
                       "unstarve the interactive lane")
+        # fleet front-door series (serving/router.py): written by the
+        # leader's data-plane router at route/retry/autoscale time —
+        # leader-side host work, never on any worker's decode path
+        m.new_gauge("app_router_routed_share",
+                    "per-host fraction of requests the leader's "
+                    "router forwarded")
+        m.new_gauge("app_router_cache_hit_ratio",
+                    "fraction of routed requests sent to a host whose "
+                    "prefix digest covered part of the prompt")
+        m.new_counter("app_router_routed",
+                      "requests the fleet router forwarded to a "
+                      "member (by host label)")
+        m.new_counter("app_router_retries",
+                      "router failovers to the next-best host on "
+                      "typed retryable rejects or connect errors "
+                      "(by code label)")
+        m.new_counter("app_router_affinity_hits",
+                      "requests routed by session affinity")
+        m.new_counter("app_router_scale_decisions",
+                      "autoscale decisions the router emitted "
+                      "(by action label)")
 
     # ------------------------------------------------------------- health
     def health(self) -> dict[str, Any]:

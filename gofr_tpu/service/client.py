@@ -190,19 +190,62 @@ class OAuth2ClientCredentials(Option):
         headers["Authorization"] = f"Bearer {self._token}"
 
 
+def parse_retry_after(value: str) -> float | None:
+    """RFC 9110 ``Retry-After``: delta-seconds or an HTTP-date.
+    Returns the wait in seconds (floored at 0), or None when the
+    header is absent/unparseable — callers fall back to backoff."""
+    if not value:
+        return None
+    value = value.strip()
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        pass
+    from email.utils import parsedate_to_datetime
+    try:
+        when = parsedate_to_datetime(value)
+    except (TypeError, ValueError):
+        return None
+    if when is None:
+        return None
+    return max(0.0, when.timestamp() - time.time())
+
+
 @dataclass
 class Retry(Option):
+    """Bounded retries with exponential backoff; 429/503 responses
+    carrying ``Retry-After`` (GoFr-parity, SURVEY §7) wait what the
+    server asked instead — capped by ``max_retry_after_s`` so a
+    hostile/buggy upstream cannot park the client for an hour."""
     max_retries: int = 3
     backoff_s: float = 0.05
+    #: honor Retry-After on 429/503 (429 is retried ONLY when the
+    #: server sent the header — a plain 429 is the caller's quota
+    #: problem, not a transient)
+    honor_retry_after: bool = True
+    max_retry_after_s: float = 30.0
+
+    def _server_wait(self, resp) -> float | None:
+        if not self.honor_retry_after or resp.status not in (429, 503):
+            return None
+        wait = parse_retry_after(resp.headers.get("retry-after", ""))
+        if wait is None:
+            return None
+        return min(wait, self.max_retry_after_s)
 
     async def around(self, call, method, path, headers, body):
         last_exc: Exception | None = None
         for attempt in range(self.max_retries + 1):
             try:
                 resp = await call(method, path, headers, body)
-                if resp.status >= 500 and attempt < self.max_retries:
-                    await asyncio.sleep(self.backoff_s * (2 ** attempt))
-                    continue
+                if attempt < self.max_retries:
+                    wait = self._server_wait(resp)
+                    if wait is not None:
+                        await asyncio.sleep(wait)
+                        continue
+                    if resp.status >= 500:
+                        await asyncio.sleep(self.backoff_s * (2 ** attempt))
+                        continue
                 return resp
             except (OSError, asyncio.TimeoutError, asyncio.IncompleteReadError) as exc:
                 last_exc = exc

@@ -230,6 +230,13 @@ class ControlPlaneLeader:
         self._lock = threading.Lock()
         self._sweeper: threading.Thread | None = None
         self._running = False
+        #: callbacks (host_id, reason) fired after a member leaves the
+        #: group for any reason (leave, sweep, degraded, scale_down) —
+        #: the fleet router drops its session-affinity entries here
+        self.evict_listeners: list = []
+        #: extra named () -> dict blocks merged into fleet_status()
+        #: (``/debug/fleet``) — the router publishes its state here
+        self.status_sources: dict[str, Any] = {}
         if metrics is not None:
             self._register_metrics(metrics)
 
@@ -288,7 +295,8 @@ class ControlPlaneLeader:
     def heartbeat(self, host_id: str, generation: int,
                   health: dict | None = None,
                   summary: dict | None = None,
-                  metrics_snapshot: dict | None = None
+                  metrics_snapshot: dict | None = None,
+                  address: str = ""
                   ) -> tuple[ShardAssignment | None, bool]:
         """-> (assignment, changed): ``changed`` is True when the
         worker's view was stale — its signal to re-coordinate.
@@ -302,6 +310,11 @@ class ControlPlaneLeader:
             if member is None:
                 raise StaleGeneration("unknown host: rejoin required")
             member.last_seen = time.time()
+            if address and member.address != address:
+                # ephemeral-port workers learn their dial address only
+                # once their server binds — adopt it from the beat so
+                # the data-plane router can reach them
+                member.address = address
             if health is not None:
                 member.health = dict(health)
             if summary is not None:
@@ -341,6 +354,24 @@ class ControlPlaneLeader:
             self.logger.warn("host evicted from serving group",
                              host=host_id, reason=reason,
                              generation=self.generation)
+        for listener in list(self.evict_listeners):
+            try:
+                listener(host_id, reason)
+            except Exception:
+                pass  # a broken listener must not block membership
+
+    def add_evict_listener(self, fn: Any) -> None:
+        self.evict_listeners.append(fn)
+
+    def routing_view(self) -> list[dict]:
+        """Snapshot for the data-plane router: one dict per member
+        with the address to dial, health status, and the latest
+        heartbeat summary (queue depth, pass timings, prefix digest)."""
+        with self._lock:
+            return [{"host_id": m.host_id, "address": m.address,
+                     "status": m.health.get("status", "UP"),
+                     "summary": dict(m.summary)}
+                    for m in self._members.values()]
 
     def topology(self) -> dict[str, Any]:
         with self._lock:
@@ -514,10 +545,16 @@ class ControlPlaneLeader:
                 bucket = tenant_usage.setdefault(tenant, {})
                 bucket[name] = round(bucket.get(name, 0.0)
                                      + float(s.get("value", 0.0)), 6)
-        return {"generation": generation, "world_size": world,
-                "fleet": self._recompute_skew(), "hosts": hosts,
-                "counter_totals": totals,
-                "tenant_usage": tenant_usage}
+        out = {"generation": generation, "world_size": world,
+               "fleet": self._recompute_skew(), "hosts": hosts,
+               "counter_totals": totals,
+               "tenant_usage": tenant_usage}
+        for name, source in self.status_sources.items():
+            try:
+                out[name] = source()
+            except Exception:
+                out[name] = {"error": "status source failed"}
+        return out
 
     def fleet_metrics_text(self) -> str:
         """The federated Prometheus exposition for
@@ -606,7 +643,8 @@ class ControlPlaneLeader:
                 int(body.get("generation", -1)),
                 body.get("health"),
                 body.get("summary"),
-                body.get("metrics") if self.fleet.federation else None)
+                body.get("metrics") if self.fleet.federation else None,
+                address=str(body.get("address", "")))
             if assignment is None:  # evicted on this very heartbeat
                 return {"ok": False, "evicted": True,
                         "generation": self.generation}
@@ -657,7 +695,8 @@ class WorkerAgent:
     SPMD program with the new rank/world (elastic restart)."""
 
     def __init__(self, leader_url: str, *, host_id: str,
-                 address: str = "", n_devices: int = 1,
+                 address: str | Callable[[], str] = "",
+                 n_devices: int = 1,
                  heartbeat_interval_s: float = 2.0,
                  on_assignment: Callable[[ShardAssignment], None]
                  | None = None,
@@ -671,6 +710,10 @@ class WorkerAgent:
                  faults: Any = None) -> None:
         from ..service import CircuitBreaker, Retry, new_http_service
         self.host_id = host_id
+        #: dial address advertised to the leader; a callable is
+        #: re-resolved on every join/heartbeat — how ephemeral-port
+        #: workers advertise an endpoint they only learn after their
+        #: server binds (App.join_fleet wires this by default)
         self.address = address
         self.n_devices = n_devices
         self.heartbeat_interval_s = heartbeat_interval_s
@@ -774,13 +817,23 @@ class WorkerAgent:
         except Exception:
             return True  # a broken probe must not strand the agent
 
+    def advertised_address(self) -> str:
+        addr = self.address
+        if callable(addr):
+            try:
+                addr = addr()
+            except Exception:
+                return ""
+        return str(addr or "")
+
     def join(self) -> ShardAssignment:
         if self.faults is not NO_FAULTS \
                 and self.faults.trip("join_refused"):
             # injected leader refusal: exercises the join-retry backoff
             raise RuntimeError("control-plane join refused (injected)")
         payload = self._post("/control/join", {
-            "host_id": self.host_id, "address": self.address,
+            "host_id": self.host_id,
+            "address": self.advertised_address(),
             "n_devices": self.n_devices,
             "health": self.health_source()})
         self._apply(payload)
@@ -807,6 +860,9 @@ class WorkerAgent:
         body: dict[str, Any] = {
             "host_id": self.host_id, "generation": generation,
             "health": self.health_source()}
+        addr = self.advertised_address()
+        if addr:
+            body["address"] = addr
         if self.summary_source is not None:
             try:
                 body["summary"] = self.summary_source()

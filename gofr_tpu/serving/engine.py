@@ -265,6 +265,12 @@ class EngineConfig:
     #: cap on pages pinned by the prefix cache; None = a quarter of
     #: the pool.
     prefix_cache_pages: int | None = None
+    #: prefix-cache digest published to the fleet: the newest N cache
+    #: keys are hashed (serving/router.py prefix_hash) at the throttled
+    #: gauge boundary and attached to heartbeat summaries so the
+    #: leader's router can score hosts by longest resident prefix.
+    #: 0 disables the digest (heartbeats carry no prefix_digest key).
+    prefix_digest_hashes: int = 64
     #: speculative decoding (opt-in): draft tokens by prompt-lookup
     #: (an n-gram of the recent context matched earlier in
     #: prompt+generated proposes its continuation) and verify them in
@@ -408,6 +414,13 @@ class Engine:
         if self.goodput.enabled:
             # heartbeats and workload headers carry the waste digest
             self.recorder.goodput_source = self.goodput.summary
+        #: prefix-cache digest for the fleet router: assembled at the
+        #: throttled gauge boundary (dirty-flagged by cache mutation
+        #: sites), read by the heartbeat thread via an atomic reference
+        self._prefix_digest: dict | None = None
+        self._prefix_digest_dirty = True
+        if config.prefix_digest_hashes > 0:
+            self.recorder.prefix_digest_source = self.prefix_digest
         #: workload capture ring (armed lazily — see EngineConfig.
         #: workload_capture); engine_seed is stamped below once the
         #: sampling seed resolves
@@ -960,6 +973,7 @@ class Engine:
             self._prefix_cache.clear()
             self._prefix_lens.clear()
             self._cached_pages = 0
+            self._prefix_digest_dirty = True
         elif lost:
             self.k_cache, self.v_cache = self._make_cache(
                 cfg.max_batch, cfg.max_seq)
@@ -1811,6 +1825,7 @@ class Engine:
             self._cached_pages -= len(pages)
             for page in pages:
                 self._decref_page(page)
+        self._prefix_digest_dirty = True
 
     def _alloc_pages(self, slot: int, rows: int) -> bool:
         """Grow ``slot``'s block table to cover ``rows`` logical rows;
@@ -1902,6 +1917,7 @@ class Engine:
         self._prefix_cache[key] = pages
         self._prefix_lens[aligned] = self._prefix_lens.get(aligned, 0) + 1
         self._cached_pages += n
+        self._prefix_digest_dirty = True
 
     @hot_path_boundary(
         "event-driven eviction; its host work is amortized over the recompute prefill it schedules, not paid per pass")
@@ -2064,6 +2080,7 @@ class Engine:
             self._prefix_cache.clear()
             self._prefix_lens.clear()
             self._cached_pages = 0
+            self._prefix_digest_dirty = True
         else:
             self.k_cache, self.v_cache = self._make_cache(
                 cfg.max_batch, cfg.max_seq)
@@ -3125,6 +3142,7 @@ class Engine:
         if dt < 0.25:
             return
         self._update_watermarks()
+        self._refresh_prefix_digest()
         tps = (self.total_generated - self._gauge_tokens) / dt
         self._gauge_wall = now
         self._gauge_tokens = self.total_generated
@@ -3181,6 +3199,39 @@ class Engine:
                         round(float(self.lengths.sum())
                               / (cfg.max_batch * cfg.max_seq), 4))
             m.set_gauge("app_engine_kv_pool_fragmentation", 0.0)
+
+    @hot_path_boundary(
+        "prefix-digest assembly at the throttled gauge cadence: host-side "
+        "hashing over cache keys already resident, skipped entirely unless "
+        "a cache mutation set the dirty flag, published by atomic "
+        "reference swap for the heartbeat thread")
+    def _refresh_prefix_digest(self) -> None:
+        """Rebuild the fleet-router digest when the prefix cache
+        changed since the last gauge pass: one truncated content hash
+        per resident cache key (newest ``prefix_digest_hashes``
+        entries — the LRU end the router should bet on)."""
+        if not self._prefix_digest_dirty:
+            return
+        self._prefix_digest_dirty = False
+        limit = max(0, int(self.config.prefix_digest_hashes))
+        if not self._prefix_enabled or not limit:
+            self._prefix_digest = None
+            return
+        from .router import prefix_hash
+        keys = list(self._prefix_cache)
+        if len(keys) > limit:
+            keys = keys[-limit:]
+        self._prefix_digest = {
+            "page": int(self.config.page_size),
+            "entries": len(self._prefix_cache),
+            "pages": int(self._cached_pages),
+            "hashes": [prefix_hash(k) for k in keys],
+        }
+
+    def prefix_digest(self) -> dict | None:
+        """Latest published digest (atomic reference read — safe from
+        the heartbeat thread); None when disabled or cache-less."""
+        return self._prefix_digest
 
     # ---------------------------------------------------------------- loop
     def _loop(self) -> None:

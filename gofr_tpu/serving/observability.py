@@ -49,6 +49,10 @@ class FlightRecorder:
         #: engine wires its meter here so fleet_summary carries the
         #: waste breakdown and the leader can say WHY a host is slow
         self.goodput_source: Any = None
+        #: optional () -> prefix-cache digest (Engine.prefix_digest);
+        #: rides fleet_summary so the leader's router can score hosts
+        #: by longest resident prefix without any new protocol
+        self.prefix_digest_source: Any = None
 
     # ------------------------------------------------------------ writers
     def record_pass(self, kind: str, **fields: Any) -> None:
@@ -118,6 +122,13 @@ class FlightRecorder:
                         "waste_s"):
                 if g.get(key) is not None:
                     out[key] = g[key]
+        if self.prefix_digest_source is not None:
+            try:
+                digest = self.prefix_digest_source()
+            except Exception:
+                digest = None
+            if digest:
+                out["prefix_digest"] = digest
         return out
 
     def dump(self, logger: Any, reason: str = "") -> None:

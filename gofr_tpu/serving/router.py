@@ -1,0 +1,804 @@
+"""Fleet front door: prefix-cache-aware data-plane router on the leader.
+
+ROADMAP item 3: the leader stops being a fleet you can only *watch*
+and starts serving. ``app.serve_fleet_leader(router=RouterConfig())``
+proxies ``POST /chat`` and the OpenAI surface to the member whose
+prefix cache already holds the request's longest pinned prefix —
+cache-hit TTFT is the single biggest latency lever the engine has
+(the ragged paged-attention block tables make prefix reuse cheap), so
+the router's job is to stop washing that reuse out across hosts.
+
+How the signal flows (zero new protocol):
+
+- each worker's engine publishes a compact **prefix-cache digest** —
+  truncated :func:`prefix_hash` values of its resident pinned prefix
+  keys, bounded by ``EngineConfig.prefix_digest_hashes`` — refreshed
+  at the throttled gauge boundary and attached to heartbeats through
+  ``FlightRecorder.fleet_summary()`` (the same path that already
+  carries queue depth, occupancy, tokens/s and the goodput digest);
+- the leader's :class:`~.control_plane.ControlPlaneLeader` keeps the
+  latest summary per member; :meth:`FleetRouter.plan` scores hosts by
+  **longest page-aligned prefix match** against the digest with a
+  load-aware tie-break (queue depth x fitted sec/token from the same
+  summaries);
+- **session affinity** (bounded LRU of session -> host, keyed on the
+  body's ``session`` field or ``X-Session-Id``) keeps multi-turn
+  chats on the host that holds their KV, and is broken the moment
+  the host drains or is evicted (the leader's evict listeners);
+- typed retryable rejects — PR 12's ``draining`` / ``engine_restart``
+  503s and any 503 carrying ``Retry-After`` — fail over to the
+  next-best host with the failed one excluded, **before** any bytes
+  were forwarded, so greedy outputs stay bit-identical and no stream
+  ever duplicates tokens;
+- responses stream through unbuffered: the proxy forwards upstream
+  chunks as they arrive (SSE passthrough rides the server's chunked
+  writer), it never accumulates a stream in memory.
+
+On the same heartbeat signals an **autoscale hook**
+(:class:`Autoscaler`): sustained queue pressure above the per-host
+setpoint (``scripts/capacity.py --json``'s max-sustainable
+concurrency) emits scale-up decisions, sustained idle occupancy emits
+scale-down decisions routed through the existing elastic join/evict
+path (``autoscale_act`` gates whether scale-down actually evicts or
+stays advisory).
+
+Everything here is leader-side host work on data the heartbeats
+already pay for; the async proxy path holds no locks across awaits
+and performs no blocking IO (gofrlint ``blocking-in-async`` — the
+fixture pair ``router_bad.py``/``router_good.py`` pins the contract).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import threading
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ..http.responder import ResponseData
+
+#: response headers mirrored back to the client on proxied replies
+_MIRROR_HEADERS = ("retry-after",)
+#: request headers forwarded upstream (auth, tracing, content nego)
+_FORWARD_HEADERS = ("content-type", "accept", "authorization",
+                    "x-api-key", "traceparent", "x-session-id")
+
+
+def prefix_hash(tokens) -> str:
+    """Stable, truncated content hash of a token-id sequence — the
+    wire format of one prefix-cache digest entry. Workers hash their
+    resident pinned prefix keys; the router hashes the request's
+    page-aligned prompt prefixes; equal sequence <=> equal hash
+    (16 hex chars of blake2b, collision odds are irrelevant at fleet
+    digest sizes)."""
+    raw = ",".join(str(int(t)) for t in tokens).encode()
+    return hashlib.blake2b(raw, digest_size=8).hexdigest()
+
+
+def aligned_prefix_hashes(prompt_tokens, page_size: int,
+                          max_pages: int) -> list[tuple[int, str]]:
+    """``[(covered_rows, hash), ...]`` for every page-aligned prefix
+    of ``prompt_tokens`` the engine could have pinned, longest first.
+    Mirrors ``Engine._probe_prefix``: at least one suffix token must
+    remain, so the longest probed prefix is page-aligned below
+    ``len(prompt) - 1``."""
+    pg = max(1, int(page_size))
+    limit = len(prompt_tokens) - 1
+    out: list[tuple[int, str]] = []
+    pages = min(limit // pg, max(0, int(max_pages)))
+    for k in range(pages, 0, -1):
+        covered = k * pg
+        out.append((covered, prefix_hash(prompt_tokens[:covered])))
+    return out
+
+
+@dataclass
+class RouterConfig:
+    """Knobs for the fleet front door (docs/configs.md)."""
+
+    #: "prefix" scores hosts by longest digest match with load
+    #: tie-break; "round_robin" rotates (the A/B baseline the router
+    #: smoke uses to prove prefix routing actually moves prefix_hits)
+    policy: str = "prefix"
+    #: bounded session -> host LRU; 0 disables affinity
+    affinity_size: int = 1024
+    #: failover attempts on ANOTHER host after the first pick refuses
+    #: with a typed retryable reject or a connect error
+    max_retries: int = 2
+    #: ``details.code`` values that mean "this host, right now" — safe
+    #: to replay on a sibling because the engine refused before
+    #: admitting (no tokens were generated)
+    retryable_codes: tuple = ("draining", "engine_restart", "engine_down")
+    #: upstream TCP connect budget
+    connect_timeout_s: float = 5.0
+    #: per-read upstream budget (response head, each body chunk)
+    read_timeout_s: float = 120.0
+    #: page-aligned prefix lengths probed against each host digest
+    digest_max_pages: int = 64
+    #: enable the autoscale hook (decisions ride /debug/fleet and the
+    #: app_router_scale_decisions counter)
+    autoscale: bool = False
+    #: per-host concurrency setpoint (active + waiting) above which
+    #: sustained pressure is a scale-up signal; 0 = take it from
+    #: ``setpoint_file``
+    setpoint_concurrency: int = 0
+    #: ``scripts/capacity.py --json`` output; read once at install
+    #: (never on the async path) for ``max_concurrency``
+    setpoint_file: str = ""
+    #: fleet mean occupancy below this is an idle (scale-down) signal
+    idle_occupancy: float = 0.10
+    #: how long a pressure/idle signal must hold before a decision
+    sustain_s: float = 30.0
+    #: minimum spacing between decisions
+    cooldown_s: float = 60.0
+    #: scale-down decisions actually evict the idlest member through
+    #: the leader (the elastic join/evict path); False = advisory only
+    autoscale_act: bool = False
+    #: decision ring kept for /debug/fleet
+    decisions_kept: int = 32
+
+
+#: leader-written router series; registered by the container's
+#: framework set and (belt-and-braces) on install()
+_ROUTER_GAUGES = (
+    ("app_router_routed_share",
+     "per-host fraction of requests this router forwarded"),
+    ("app_router_cache_hit_ratio",
+     "fraction of routed requests sent to a host whose prefix digest "
+     "covered part of the prompt"),
+)
+_ROUTER_COUNTERS = (
+    ("app_router_routed",
+     "requests forwarded to a member (by host label)"),
+    ("app_router_retries",
+     "typed-reject / connect-error failovers to the next-best host "
+     "(by code label)"),
+    ("app_router_affinity_hits",
+     "requests routed by session affinity"),
+    ("app_router_scale_decisions",
+     "autoscale decisions emitted (by action label)"),
+)
+
+
+class SessionAffinity:
+    """Bounded session -> host LRU. Touched from the event loop (route
+    time) and from leader threads (evict listeners), so every mutation
+    holds the lock — entries for a drained/evicted host drop in one
+    sweep."""
+
+    def __init__(self, size: int) -> None:
+        self.size = max(0, int(size))
+        self._map: OrderedDict[str, str] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, session: str) -> str | None:
+        if not self.size or not session:
+            return None
+        with self._lock:
+            host = self._map.get(session)
+            if host is not None:
+                self._map.move_to_end(session)
+            return host
+
+    def put(self, session: str, host: str) -> None:
+        if not self.size or not session:
+            return
+        with self._lock:
+            self._map[session] = host
+            self._map.move_to_end(session)
+            while len(self._map) > self.size:
+                self._map.popitem(last=False)
+
+    def drop_host(self, host: str) -> int:
+        with self._lock:
+            dead = [s for s, h in self._map.items() if h == host]
+            for s in dead:
+                del self._map[s]
+            return len(dead)
+
+    def state(self) -> dict:
+        with self._lock:
+            return {"size": self.size, "entries": len(self._map)}
+
+
+class Autoscaler:
+    """Sustained-signal scale decisions over the fleet view the router
+    already reads. Pure host arithmetic with an injectable clock (the
+    tests pin it); decisions land in a ring, a counter, and optionally
+    the leader's evict path."""
+
+    def __init__(self, config: RouterConfig, *,
+                 clock: Callable[[], float] = time.monotonic,
+                 metrics: Any = None, logger: Any = None,
+                 on_decision: Callable[[dict], None] | None = None) -> None:
+        self.config = config
+        self.clock = clock
+        self.metrics = metrics
+        self.logger = logger
+        self.on_decision = on_decision
+        self.setpoint = int(config.setpoint_concurrency)
+        self.decisions: deque = deque(maxlen=max(1, config.decisions_kept))
+        self._pressure_since: float | None = None
+        self._idle_since: float | None = None
+        self._last_decision = -float("inf")
+
+    def load_setpoint_file(self, path: str) -> None:
+        """Read a ``scripts/capacity.py --json`` setpoint file. Called
+        at install time only — never from the async proxy path."""
+        if not path:
+            return
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            self.setpoint = int(doc.get("max_concurrency") or 0)
+        except (OSError, ValueError) as exc:
+            if self.logger:
+                self.logger.warn(
+                    f"autoscaler setpoint file unreadable: {exc}")
+
+    def observe(self, hosts: list[dict]) -> dict | None:
+        """One tick over the member views; returns the decision dict
+        when one fires (also recorded), else None."""
+        now = self.clock()
+        world = len(hosts)
+        if not world:
+            self._pressure_since = self._idle_since = None
+            return None
+        loads = []
+        occs = []
+        for h in hosts:
+            s = h.get("summary") or {}
+            loads.append(float(s.get("active_slots") or 0)
+                         + float(s.get("waiting") or 0))
+            if isinstance(s.get("occupancy_mean"), (int, float)):
+                occs.append(float(s["occupancy_mean"]))
+        mean_load = sum(loads) / world
+        mean_occ = (sum(occs) / len(occs)) if occs else None
+        pressure = self.setpoint > 0 and mean_load > self.setpoint
+        idle = (mean_occ is not None and world > 1
+                and mean_occ < self.config.idle_occupancy
+                and not pressure)
+        self._pressure_since = (self._pressure_since or now) \
+            if pressure else None
+        self._idle_since = (self._idle_since or now) if idle else None
+        if now - self._last_decision < self.config.cooldown_s:
+            return None
+        sustain = self.config.sustain_s
+        if self._pressure_since is not None \
+                and now - self._pressure_since >= sustain:
+            return self._decide(
+                "scale_up", now,
+                reason=f"mean in-flight {mean_load:.1f} > setpoint "
+                       f"{self.setpoint} for {sustain:.0f}s",
+                mean_load=round(mean_load, 2), world=world)
+        if self._idle_since is not None \
+                and now - self._idle_since >= sustain:
+            victim = min(
+                hosts, key=lambda h: (
+                    float((h.get("summary") or {}).get("active_slots")
+                          or 0)
+                    + float((h.get("summary") or {}).get("waiting")
+                            or 0),
+                    h.get("host_id", "")))
+            return self._decide(
+                "scale_down", now,
+                reason=f"mean occupancy {mean_occ:.3f} < "
+                       f"{self.config.idle_occupancy} for {sustain:.0f}s",
+                victim=victim.get("host_id"), world=world)
+        return None
+
+    def _decide(self, action: str, now: float, **extra: Any) -> dict:
+        self._last_decision = now
+        self._pressure_since = self._idle_since = None
+        decision = {"action": action, "at": round(now, 3),
+                    "setpoint": self.setpoint, **extra}
+        self.decisions.append(decision)
+        if self.metrics is not None:
+            self.metrics.increment_counter("app_router_scale_decisions",
+                                           action=action)
+        if self.logger:
+            self.logger.warn("autoscale decision", **decision)
+        if self.on_decision is not None:
+            try:
+                self.on_decision(decision)
+            except Exception:
+                pass  # a broken hook must not break routing
+        return decision
+
+    def state(self) -> dict:
+        return {"setpoint": self.setpoint,
+                "decisions": list(self.decisions)}
+
+
+class FleetRouter:
+    """The data-plane half of the leader: plan (score members against
+    the request), proxy (stream through, fail over on typed rejects),
+    account (``app_router_*``), and optionally autoscale."""
+
+    def __init__(self, leader: Any, config: RouterConfig | None = None,
+                 *, tokenizer: Any = None, metrics: Any = None,
+                 logger: Any = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if tokenizer is None:
+            from .tokenizer import ByteTokenizer
+            tokenizer = ByteTokenizer()
+        self.leader = leader
+        self.config = config if config is not None else RouterConfig()
+        self.tokenizer = tokenizer
+        self.metrics = metrics
+        self.logger = logger
+        self.clock = clock
+        self.affinity = SessionAffinity(self.config.affinity_size)
+        self.autoscaler: Autoscaler | None = None
+        if self.config.autoscale:
+            self.autoscaler = Autoscaler(
+                self.config, clock=clock, metrics=metrics, logger=logger,
+                on_decision=self._act_on_decision
+                if self.config.autoscale_act else None)
+        #: routed accounting, all under _lock: per-host counts feed the
+        #: share gauge and /debug/fleet; hits feed the cache-hit ratio
+        self._lock = threading.Lock()
+        self._routed: dict[str, int] = {}
+        self._routed_total = 0
+        self._routed_cache_hits = 0
+        self._affinity_hits = 0
+        self._retries = 0
+        self._rr_next = 0
+        self._autoscale_tick = -float("inf")
+        if hasattr(leader, "add_evict_listener"):
+            leader.add_evict_listener(self._on_member_gone)
+
+    # ------------------------------------------------------- membership
+    def _on_member_gone(self, host_id: str, reason: str) -> None:
+        dropped = self.affinity.drop_host(host_id)
+        if dropped and self.logger:
+            self.logger.info(
+                "router dropped session affinity for departed host",
+                host=host_id, reason=reason, sessions=dropped)
+
+    def _members(self) -> list[dict]:
+        view = self.leader.routing_view()
+        return [m for m in view if m.get("status", "UP") == "UP"
+                and m.get("address")]
+
+    # ---------------------------------------------------------- scoring
+    @staticmethod
+    def _load(summary: dict) -> float:
+        """Queue depth x fitted sec/token: in-flight work scaled by
+        how fast this host retires it. ``pass_p50_s`` is the per-token
+        decode cadence; its absence falls back to 1/tokens_per_s, then
+        to raw depth (cold host, no passes yet)."""
+        depth = (float(summary.get("active_slots") or 0)
+                 + float(summary.get("waiting") or 0))
+        spt = summary.get("pass_p50_s")
+        if not isinstance(spt, (int, float)) or spt <= 0:
+            tps = summary.get("tokens_per_s")
+            spt = 1.0 / float(tps) if isinstance(tps, (int, float)) \
+                and tps > 0 else 1.0
+        return depth * float(spt)
+
+    def _covered(self, member: dict, prompt_tokens) -> int:
+        digest = (member.get("summary") or {}).get("prefix_digest")
+        if not isinstance(digest, dict):
+            return 0
+        hashes = digest.get("hashes")
+        if not hashes:
+            return 0
+        resident = set(hashes)
+        for covered, h in aligned_prefix_hashes(
+                prompt_tokens, digest.get("page") or 1,
+                self.config.digest_max_pages):
+            if h in resident:
+                return covered
+        return 0
+
+    def plan(self, prompt_tokens, session: str | None = None
+             ) -> list[dict]:
+        """Ordered candidates for one request: each
+        ``{host_id, address, covered, load, affinity}``. First entry
+        is the route; the rest are the failover ladder."""
+        members = self._members()
+        self._maybe_autoscale(members)
+        if not members:
+            return []
+        if self.config.policy == "round_robin":
+            members.sort(key=lambda m: m["host_id"])
+            with self._lock:
+                start = self._rr_next % len(members)
+                self._rr_next += 1
+            ordered = members[start:] + members[:start]
+            return [{"host_id": m["host_id"], "address": m["address"],
+                     "covered": 0, "load": 0.0, "affinity": False}
+                    for m in ordered]
+        scored = []
+        for m in members:
+            summary = m.get("summary") or {}
+            scored.append({
+                "host_id": m["host_id"], "address": m["address"],
+                "covered": self._covered(m, prompt_tokens),
+                "load": round(self._load(summary), 6),
+                "affinity": False,
+            })
+        scored.sort(key=lambda c: (-c["covered"], c["load"],
+                                   c["host_id"]))
+        pinned = self.affinity.get(session) if session else None
+        if pinned is not None:
+            for i, c in enumerate(scored):
+                if c["host_id"] == pinned:
+                    c["affinity"] = True
+                    scored.insert(0, scored.pop(i))
+                    break
+        return scored
+
+    def _maybe_autoscale(self, members: list[dict]) -> None:
+        if self.autoscaler is None:
+            return
+        now = self.clock()
+        with self._lock:
+            if now - self._autoscale_tick < 1.0:
+                return
+            self._autoscale_tick = now
+        self.autoscaler.observe(members)
+
+    def _act_on_decision(self, decision: dict) -> None:
+        """``autoscale_act``: scale-down rides the existing elastic
+        evict path — the evicted worker's agent backs off and can
+        rejoin when the fleet scales back up. Scale-up stays advisory
+        (the leader cannot conjure hosts; operators or an external
+        provisioner watch the decision ring)."""
+        if decision.get("action") != "scale_down":
+            return
+        victim = decision.get("victim")
+        if victim and hasattr(self.leader, "evict"):
+            self.leader.evict(victim, reason="scale_down")
+
+    # ------------------------------------------------------- accounting
+    def _note_routed(self, cand: dict, session: str | None,
+                     retried: int) -> None:
+        with self._lock:
+            host = cand["host_id"]
+            self._routed[host] = self._routed.get(host, 0) + 1
+            self._routed_total += 1
+            if cand["covered"] > 0:
+                self._routed_cache_hits += 1
+            if cand["affinity"]:
+                self._affinity_hits += 1
+            self._retries += retried
+            total = self._routed_total
+            shares = {h: n / total for h, n in self._routed.items()}
+            ratio = self._routed_cache_hits / total
+        if session:
+            self.affinity.put(session, host)
+        m = self.metrics
+        if m is None:
+            return
+        m.increment_counter("app_router_routed", host=host)
+        if cand["affinity"]:
+            m.increment_counter("app_router_affinity_hits")
+        for h, share in shares.items():
+            m.set_gauge("app_router_routed_share", round(share, 4),
+                        host=h)
+        m.set_gauge("app_router_cache_hit_ratio", round(ratio, 4))
+
+    def _note_retry(self, code: str) -> None:
+        if self.metrics is not None:
+            self.metrics.increment_counter("app_router_retries",
+                                           code=code)
+
+    # ------------------------------------------------------------ proxy
+    @staticmethod
+    def routing_text(path: str, body: dict) -> str:
+        """The prompt text a worker will tokenize for this request —
+        the router must hash the same bytes the engine caches.
+        Mirrors make_chat_handler for /chat and the OpenAI chat
+        template for /v1/*; best-effort (malformed bodies route by
+        load alone and let the worker emit the typed 4xx)."""
+        if path.startswith("/v1/chat"):
+            messages = body.get("messages")
+            if not isinstance(messages, list):
+                return ""
+            parts = []
+            for m in messages:
+                if not isinstance(m, dict):
+                    return ""
+                content = m.get("content")
+                if isinstance(content, list):
+                    content = "".join(
+                        str(p.get("text", "")) for p in content
+                        if isinstance(p, dict))
+                parts.append(f"{m.get('role', 'user')}: {content}")
+            parts.append("assistant:")
+            return "\n".join(parts)
+        prompt = body.get("prompt")
+        if isinstance(prompt, str):
+            return prompt
+        if isinstance(body.get("messages"), list):
+            return "\n".join(str(m.get("content", ""))
+                             for m in body["messages"]
+                             if isinstance(m, dict))
+        return ""
+
+    def make_proxy(self, path: str):
+        """A proxy handler bound to one upstream path."""
+
+        async def proxy(ctx):
+            return await self.proxy_request(ctx, path)
+
+        proxy.__name__ = f"route_{path.strip('/').replace('/', '_')}"
+        return proxy
+
+    async def proxy_request(self, ctx, path: str) -> ResponseData:
+        request = ctx.request
+        raw_body = getattr(request, "body", b"") or b""
+        try:
+            body = json.loads(raw_body) if raw_body else {}
+        except ValueError:
+            body = {}
+        if not isinstance(body, dict):
+            body = {}
+        session = body.get("session") \
+            or request.header("x-session-id") or None
+        if session is not None:
+            session = str(session)
+        prompt_tokens = self.tokenizer.encode(
+            self.routing_text(path, body))
+        plan = self.plan(prompt_tokens, session)
+        if not plan:
+            from ..http.errors import ErrorServiceUnavailable
+            raise ErrorServiceUnavailable(
+                "no fleet members available to route to",
+                details={"code": "no_members"},
+                headers={"Retry-After": "1"})
+        headers = {k: request.header(k) for k in _FORWARD_HEADERS
+                   if request.header(k)}
+        attempts = min(len(plan), self.config.max_retries + 1)
+        last: ResponseData | None = None
+        retry_code = ""
+        for attempt in range(attempts):
+            cand = plan[attempt]
+            if attempt:
+                self._note_retry(retry_code)
+            try:
+                status, uhdrs, reader, writer = await _open_upstream(
+                    "POST", cand["address"], path, headers, raw_body,
+                    connect_timeout=self.config.connect_timeout_s,
+                    read_timeout=self.config.read_timeout_s)
+            except (OSError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError) as exc:
+                retry_code = "connect_error"
+                last = _error_response(
+                    502, f"upstream {cand['host_id']} unreachable: "
+                         f"{exc!r}")
+                continue
+            if status in (429, 503):
+                # typed admission rejects are small JSON bodies; read
+                # them fully to see details.code, then either fail
+                # over (zero bytes were forwarded) or mirror verbatim
+                payload = await _read_all(
+                    reader, writer, uhdrs, self.config.read_timeout_s)
+                code = _reject_code(payload)
+                last = _mirror(status, uhdrs, payload)
+                if status == 503 and attempt < attempts - 1 and (
+                        code in self.config.retryable_codes
+                        or "retry-after" in uhdrs):
+                    retry_code = code or "503"
+                    continue
+                return last
+            self._note_routed(cand, session, retried=attempt)
+            ctype = uhdrs.get("content-type",
+                              "application/octet-stream")
+            if uhdrs.get("transfer-encoding", "").lower() == "chunked" \
+                    or "text/event-stream" in ctype:
+                return ResponseData(
+                    status=status, content_type=ctype,
+                    headers=_mirror_headers(uhdrs),
+                    stream=_iter_body(reader, writer, uhdrs,
+                                      self.config.read_timeout_s))
+            payload = await _read_all(reader, writer, uhdrs,
+                                      self.config.read_timeout_s)
+            return _mirror(status, uhdrs, payload)
+        assert last is not None
+        return last
+
+    # ------------------------------------------------------------ misc
+    async def models_proxy(self, ctx) -> ResponseData:
+        """GET /v1/models passthrough to the first healthy member (the
+        model list is identical fleet-wide)."""
+        for m in self._members():
+            try:
+                status, uhdrs, reader, writer = await _open_upstream(
+                    "GET", m["address"], "/v1/models", {}, b"",
+                    connect_timeout=self.config.connect_timeout_s,
+                    read_timeout=self.config.read_timeout_s)
+            except (OSError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError):
+                continue
+            payload = await _read_all(reader, writer, uhdrs,
+                                      self.config.read_timeout_s)
+            return _mirror(status, uhdrs, payload)
+        from ..http.errors import ErrorServiceUnavailable
+        raise ErrorServiceUnavailable(
+            "no fleet members available",
+            details={"code": "no_members"},
+            headers={"Retry-After": "1"})
+
+    def debug_state(self) -> dict:
+        """The ``router`` block of ``/debug/fleet``."""
+        with self._lock:
+            routed = dict(self._routed)
+            total = self._routed_total
+            hits = self._routed_cache_hits
+            affinity_hits = self._affinity_hits
+            retries = self._retries
+        out = {
+            "policy": self.config.policy,
+            "routed": routed,
+            "routed_total": total,
+            "cache_hit_ratio": round(hits / total, 4) if total else 0.0,
+            "affinity": {**self.affinity.state(),
+                         "hits": affinity_hits},
+            "retries": retries,
+        }
+        if self.autoscaler is not None:
+            out["autoscale"] = self.autoscaler.state()
+        return out
+
+    def install(self, app: Any,
+                paths: tuple = ("/chat", "/v1/chat/completions",
+                                "/v1/completions")) -> None:
+        """Register the proxy routes on the leader app and adopt its
+        metrics manager."""
+        if self.metrics is None:
+            self.metrics = app.container.metrics
+            if self.autoscaler is not None:
+                self.autoscaler.metrics = self.metrics
+        for name, desc in _ROUTER_GAUGES:
+            if self.metrics.get(name) is None:
+                self.metrics.new_gauge(name, desc)
+        for name, desc in _ROUTER_COUNTERS:
+            if self.metrics.get(name) is None:
+                self.metrics.new_counter(name, desc)
+        if self.autoscaler is not None and self.config.setpoint_file:
+            self.autoscaler.load_setpoint_file(self.config.setpoint_file)
+        for path in paths:
+            app.post(path, self.make_proxy(path))
+        if any(p.startswith("/v1/") for p in paths):
+            app.get("/v1/models", self.models_proxy)
+        if hasattr(self.leader, "status_sources"):
+            self.leader.status_sources["router"] = self.debug_state
+
+
+# --------------------------------------------------- upstream transport
+#
+# The service client's _raw_request buffers the whole response — fine
+# for control RPCs, useless for SSE passthrough. This half-duplex
+# reader hands the body back incrementally so the proxy forwards
+# chunks the moment they arrive.
+
+def _base_parts(address: str) -> tuple[str, int]:
+    """``host:port`` or ``http://host:port`` -> (host, port)."""
+    addr = address
+    if "://" in addr:
+        addr = addr.split("://", 1)[1]
+    addr = addr.split("/", 1)[0]
+    host, _, port = addr.rpartition(":")
+    if not host:
+        return addr, 80
+    return host, int(port)
+
+
+async def _open_upstream(method: str, address: str, path: str,
+                         headers: dict, body: bytes, *,
+                         connect_timeout: float, read_timeout: float):
+    """Dial a member, send the request, parse the response head.
+    Returns ``(status, lowercase-headers, reader, writer)`` with the
+    body left on the wire for :func:`_iter_body` / :func:`_read_all`."""
+    from ..http.server import MAX_HEADER_BYTES
+    host, port = _base_parts(address)
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, port, limit=MAX_HEADER_BYTES),
+        connect_timeout)
+    try:
+        head = [f"{method} {path} HTTP/1.1",
+                f"Host: {host}:{port}",
+                "Connection: close",
+                f"Content-Length: {len(body)}"]
+        head.extend(f"{k}: {v}" for k, v in headers.items())
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode("latin-1")
+                     + body)
+        await writer.drain()
+        raw = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"),
+                                     read_timeout)
+    except BaseException:
+        writer.close()
+        raise
+    lines = raw.decode("latin-1").split("\r\n")
+    status = int(lines[0].split(" ", 2)[1])
+    uhdrs: dict[str, str] = {}
+    for line in lines[1:]:
+        if ":" in line:
+            k, _, v = line.partition(":")
+            uhdrs[k.strip().lower()] = v.strip()
+    return status, uhdrs, reader, writer
+
+
+async def _iter_body(reader, writer, uhdrs: dict, timeout: float):
+    """Incremental body iterator: yields chunks as the upstream sends
+    them. Closing this generator (client disconnect) closes the
+    upstream socket, which cancels the worker's stream producer."""
+    try:
+        if uhdrs.get("transfer-encoding", "").lower() == "chunked":
+            while True:
+                size_line = await asyncio.wait_for(reader.readline(),
+                                                   timeout)
+                size = int(size_line.strip().split(b";")[0] or b"0", 16)
+                if size == 0:
+                    break
+                yield await asyncio.wait_for(reader.readexactly(size),
+                                             timeout)
+                await reader.readexactly(2)
+        elif "content-length" in uhdrs:
+            remaining = int(uhdrs["content-length"])
+            while remaining > 0:
+                chunk = await asyncio.wait_for(
+                    reader.read(min(65536, remaining)), timeout)
+                if not chunk:
+                    break
+                remaining -= len(chunk)
+                yield chunk
+        else:
+            while True:
+                chunk = await asyncio.wait_for(reader.read(65536),
+                                               timeout)
+                if not chunk:
+                    break
+                yield chunk
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+
+
+async def _read_all(reader, writer, uhdrs: dict,
+                    timeout: float) -> bytes:
+    chunks = []
+    async for chunk in _iter_body(reader, writer, uhdrs, timeout):
+        chunks.append(chunk)
+    return b"".join(chunks)
+
+
+def _mirror_headers(uhdrs: dict) -> dict:
+    return {k.title(): v for k, v in uhdrs.items()
+            if k in _MIRROR_HEADERS}
+
+
+def _mirror(status: int, uhdrs: dict, payload: bytes) -> ResponseData:
+    return ResponseData(
+        status=status, body=payload, headers=_mirror_headers(uhdrs),
+        content_type=uhdrs.get("content-type", "application/json"))
+
+
+def _reject_code(payload: bytes) -> str:
+    """``details.code`` out of a worker's typed error envelope."""
+    try:
+        doc = json.loads(payload)
+        return str(((doc.get("error") or {}).get("details") or {})
+                   .get("code") or "")
+    except (ValueError, AttributeError):
+        return ""
+
+
+def _error_response(status: int, message: str) -> ResponseData:
+    return ResponseData(
+        status=status,
+        body=json.dumps({"error": {"message": message}}).encode())
+
+
+__all__ = ["FleetRouter", "RouterConfig", "Autoscaler",
+           "SessionAffinity", "prefix_hash", "aligned_prefix_hashes"]

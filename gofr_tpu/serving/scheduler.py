@@ -653,16 +653,21 @@ class Scheduler:
                 "burn_rate": round(err / budget, 4)}
 
     # ------------------------------------------------------------- state
-    def state(self) -> dict:
+    def state(self, fresh: bool = False) -> dict:
         """The ``GET /debug/scheduler`` payload: policy, lane depths,
         per-tenant shares/weights/queues/burn, rate-limit levels, shed
-        episode state and the admission counters."""
+        episode state and the admission counters. ``fresh=True``
+        bypasses the 0.5s share-cache throttle so the view reflects
+        every retire that already landed — the ``?fresh=1`` debug
+        query the smokes use instead of sleeping out the window."""
         now_m = time.monotonic()
         wall = time.time()
         slo = self.slo_source() if callable(self.slo_source) else None
         availability = getattr(getattr(slo, "config", None),
                                "availability", 0.999)
         with self._lock:
+            if fresh:
+                self._share_refreshed = 0.0
             self._refresh_shares_locked(now_m)
             lanes = {lane: sum(len(ts.queues[lane])
                                for ts in self._tenants.values())

@@ -5,7 +5,7 @@ Usage:
     python scripts/capacity.py WORKLOAD.jsonl
         [--levels 1,2,4,8,16] [--seed S] [--max-batch B] [--max-seq L]
         [--ttft-s 2.0] [--tpot-s 0.5] [--e2e-s 30] [--availability A]
-        [--timeout T] [--report OUT.json]
+        [--timeout T] [--report OUT.json] [--json SETPOINT.json]
 
 Replays a captured workload (``GET /debug/workload``) through a local
 engine at increasing ``--closed-loop`` concurrency. At each level the
@@ -101,6 +101,24 @@ def sweep(engine, workload, levels, slo_config,
     }
 
 
+def setpoint_doc(result: dict) -> dict:
+    """The ``--json`` setpoint file: the exact subset the router
+    autoscaler (``RouterConfig.setpoint_file``) and CI consume —
+    stable keys, no stdout scraping."""
+    return {
+        "max_concurrency": result.get("max_sustainable_concurrency", 0),
+        "qps": result.get("max_sustainable_qps", 0.0),
+        "tripped_at": result.get("tripped_at"),
+        "levels": [
+            {"concurrency": e.get("concurrency"),
+             "qps": e.get("qps"),
+             "goodput_ratio": e.get("goodput_ratio"),
+             "tripped": bool(e.get("tripped"))}
+            for e in result.get("levels", [])
+        ],
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("workload", help="workload JSONL file "
@@ -120,6 +138,11 @@ def main() -> int:
                     help="per-level replay timeout")
     ap.add_argument("--report", default=None,
                     help="also write the report JSON to this path")
+    ap.add_argument("--json", dest="setpoint", default=None,
+                    metavar="OUT",
+                    help="write a machine-readable setpoint file "
+                    "(max_concurrency, qps, per-level goodput) for "
+                    "the router autoscaler and CI")
     args = ap.parse_args()
 
     try:
@@ -177,6 +200,10 @@ def main() -> int:
     if args.report:
         with open(args.report, "w") as f:
             f.write(text + "\n")
+    if args.setpoint:
+        with open(args.setpoint, "w") as f:
+            json.dump(setpoint_doc(result), f, indent=2)
+            f.write("\n")
     return 0
 
 

@@ -22,7 +22,6 @@ import json
 import os
 import sys
 import threading
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -121,10 +120,9 @@ def main() -> int:
             assert status == 201, (status, data[:200])
         print("ok: victim traffic all 201 beside the flood")
 
-        # the scheduler's ledger-share cache refreshes at most twice a
-        # second; let it lapse so the victim's retires are visible
-        time.sleep(0.6)
-        status, _, data = request(port, "GET", "/debug/scheduler",
+        # ?fresh=1 forces a ledger-share refresh past the 0.5s cache
+        # window, so the victim's retires are visible with no sleep
+        status, _, data = request(port, "GET", "/debug/scheduler?fresh=1",
                                   headers={"X-Api-Key": "victim-key"})
         assert status == 200, status
         sched = json.loads(data)["data"]["llm"]

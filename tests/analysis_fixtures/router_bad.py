@@ -1,0 +1,38 @@
+"""The router contract, violated both ways: blocking IO inside the
+async proxy path, and prefix-digest assembly inlined in the engine's
+hot loop. Lines matter — test_analysis.py pins them."""
+import time
+
+import requests
+
+from gofr_tpu.analysis import hot_path
+
+
+class Router:
+    async def proxy(self, ctx):
+        # the async data plane must never block the event loop: a
+        # sleep, a setpoint-file read or a sync health probe stalls
+        # EVERY stream the leader is proxying
+        time.sleep(0.05)                                 # L16: blocks
+        requests.get("http://worker:8476/healthz")       # L17: sync HTTP
+        with open("/etc/router/setpoint.json") as f:     # L18: sync IO
+            self.setpoint = f.read()
+        return await self.forward(ctx)
+
+
+class Engine:
+    @hot_path
+    def collect(self, batch):
+        # digest assembly inlined in a hot root: hashing, clocks and
+        # telemetry ride every decode pass instead of the throttled
+        # gauge boundary
+        self.digest_at = time.time()                     # L29: clock
+        self.metrics.set_gauge(                          # L30: metric
+            "app_router_cache_hit_ratio", 1.0)
+        return self._hash_cache(batch)
+
+    def _hash_cache(self, batch):
+        # undecorated digest helper statically reached from the hot
+        # root: the closure walk must flag it too
+        self.logger.info("digest rebuilt")               # L37: logging
+        return len(batch)

@@ -1,0 +1,40 @@
+"""The router contract, honored: the async proxy path awaits all its
+IO, and digest assembly exits the hot closure through a declared
+@hot_path_boundary — the serving/router.py + Engine._refresh_prefix_
+digest contract. None of this may flag."""
+import asyncio
+import time
+
+from gofr_tpu.analysis import hot_path, hot_path_boundary
+
+
+class Router:
+    async def proxy(self, ctx):
+        # pure async data plane: upstream IO awaits, the setpoint file
+        # was read once at install time, health rides the heartbeats
+        reader, writer = await asyncio.open_connection("worker", 8476)
+        writer.write(b"POST /chat HTTP/1.1\r\n\r\n")
+        await writer.drain()
+        chunk = await reader.read(65536)
+        writer.close()
+        return chunk
+
+
+class Engine:
+    @hot_path
+    def collect(self, batch):
+        # the hot root only touches the declared boundary — digest
+        # work happens at the throttled gauge cadence, not per pass
+        self._refresh_prefix_digest()
+        return len(batch)
+
+    @hot_path_boundary(
+        "digest assembly at the throttled gauge cadence: host-side "
+        "hashing over cache keys already resident, published by "
+        "atomic reference swap")
+    def _refresh_prefix_digest(self):
+        # inside the boundary the digest may consult clocks and write
+        # its gauges — that is the point of the boundary
+        self.digest_at = time.time()
+        self.metrics.set_gauge("app_router_cache_hit_ratio", 1.0)
+        self.logger.info("digest rebuilt")

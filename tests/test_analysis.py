@@ -120,6 +120,50 @@ class TestFaultInjectionSites:
         assert isinstance(reason, str) and reason.strip()
 
 
+# ----------------------------------------------------- router contract
+class TestRouterContract:
+    """The serving/router.py contract, lint-enforced: the async proxy
+    path must never block the event loop (every stream the leader
+    proxies rides it), and prefix-digest assembly is legal ONLY behind
+    a declared @hot_path_boundary — inline in a hot root or in a
+    closure-reached helper it must flag."""
+
+    def test_blocking_proxy_path_flags(self):
+        got = violations(lint("router_bad.py"), "blocking-in-async")
+        # sleep, sync HTTP probe, setpoint-file read — all inline in
+        # the async proxy
+        assert {f.line for f in got} == {16, 17, 18}
+
+    def test_inline_digest_assembly_flags(self):
+        got = violations(lint("router_bad.py"), "hot-path-purity")
+        lines = {f.line for f in got}
+        assert {29, 30} <= lines        # clock + gauge in the hot root
+        assert 37 in lines              # closure-reached digest helper
+
+    def test_clean_twin_is_silent_on_both_rules(self):
+        got = lint("router_good.py")
+        assert violations(got, "blocking-in-async") == []
+        assert violations(got, "hot-path-purity") == []
+
+    def test_live_digest_refresh_declares_a_boundary(self):
+        # the real module, not a fixture: the engine's digest refresh
+        # runs off the gauge pass inside the hot loop, so losing its
+        # boundary would drag hashing into the hot closure
+        from gofr_tpu.serving.engine import Engine
+        reason = getattr(Engine._refresh_prefix_digest,
+                         "__gofr_hot_path_boundary__", "")
+        assert isinstance(reason, str) and reason.strip()
+
+    def test_live_proxy_path_is_async_clean(self):
+        # the real router module must pass the blocking-in-async rule
+        # it exists to model
+        findings, _ = run_analysis(
+            [REPO / "gofr_tpu" / "serving" / "router.py"], root=REPO)
+        assert [f for f in findings
+                if not f.suppressed
+                and f.rule == "blocking-in-async"] == []
+
+
 # ---------------------------------------------------------------- locks
 class TestLockDiscipline:
     def test_bad_fixture(self):

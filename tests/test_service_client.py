@@ -181,3 +181,99 @@ def test_circuit_breaker_lazy_half_open_across_loops(upstream):
     time_mod.sleep(0.06)
     resp = call(svc, "get", "/flaky")  # half-open trial, new loop
     assert resp.ok and not cb.is_open
+
+
+# ---------------------------------------------------------- retry-after
+class TestRetryAfter:
+    """Retry honors a server-stated Retry-After on 429/503 (seconds
+    and HTTP-date forms) instead of its own exponential backoff."""
+
+    def test_parse_delta_seconds(self):
+        from gofr_tpu.service.client import parse_retry_after
+        assert parse_retry_after("7") == 7.0
+        assert parse_retry_after(" 2.5 ") == 2.5
+        assert parse_retry_after("-3") == 0.0
+
+    def test_parse_http_date(self):
+        import time as time_mod
+        from email.utils import formatdate
+        from gofr_tpu.service.client import parse_retry_after
+        wait = parse_retry_after(
+            formatdate(time_mod.time() + 10, usegmt=True))
+        assert wait is not None and 7.0 < wait <= 10.5
+        # a date already in the past floors at zero, never negative
+        past = parse_retry_after(
+            formatdate(time_mod.time() - 60, usegmt=True))
+        assert past == 0.0
+
+    def test_parse_garbage_is_none(self):
+        from gofr_tpu.service.client import parse_retry_after
+        assert parse_retry_after("") is None
+        assert parse_retry_after("soon") is None
+        assert parse_retry_after("Wed, 99 Foo") is None
+
+    def _run(self, retry, responses, slept):
+        """Drive Retry.around against a scripted upstream, recording
+        every sleep instead of waiting it out."""
+
+        class FakeResp:
+            def __init__(self, status, headers=None):
+                self.status = status
+                self.headers = headers or {}
+
+        script = list(responses)
+
+        async def fake_call(method, path, headers, body):
+            return FakeResp(*script.pop(0))
+
+        async def fake_sleep(s):
+            slept.append(s)
+
+        real_sleep = asyncio.sleep
+        asyncio.sleep = fake_sleep
+        try:
+            return asyncio.run(
+                retry.around(fake_call, "GET", "/x", {}, None))
+        finally:
+            asyncio.sleep = real_sleep
+
+    def test_503_waits_what_the_server_asked(self):
+        slept = []
+        resp = self._run(Retry(max_retries=2, backoff_s=0.01),
+                         [(503, {"retry-after": "4"}), (200,)], slept)
+        assert resp.status == 200
+        assert slept == [4.0]
+
+    def test_429_retries_only_with_the_header(self):
+        slept = []
+        resp = self._run(Retry(max_retries=2, backoff_s=0.01),
+                         [(429, {"retry-after": "1"}), (200,)], slept)
+        assert resp.status == 200 and slept == [1.0]
+        # a bare 429 is a quota answer, not a transient: no retry
+        slept = []
+        resp = self._run(Retry(max_retries=2, backoff_s=0.01),
+                         [(429,), (200,)], slept)
+        assert resp.status == 429 and slept == []
+
+    def test_wait_is_capped(self):
+        slept = []
+        resp = self._run(
+            Retry(max_retries=1, backoff_s=0.01, max_retry_after_s=5.0),
+            [(503, {"retry-after": "3600"}), (200,)], slept)
+        assert resp.status == 200
+        assert slept == [5.0]
+
+    def test_unparseable_header_falls_back_to_backoff(self):
+        slept = []
+        resp = self._run(Retry(max_retries=1, backoff_s=0.25),
+                         [(503, {"retry-after": "later"}), (200,)], slept)
+        assert resp.status == 200
+        assert slept == [0.25]
+
+    def test_honor_disabled_uses_backoff(self):
+        slept = []
+        resp = self._run(
+            Retry(max_retries=1, backoff_s=0.5, honor_retry_after=False),
+            [(503, {"retry-after": "9"}), (200,)], slept)
+        assert resp.status == 200
+        assert slept == [0.5]
