@@ -393,6 +393,7 @@ class App:
             sources = {"health_source": health,
                        "summary_source": summary}
         kw.setdefault("metrics_source", self.container.metrics.snapshot)
+        kw.setdefault("metrics", self.container.metrics)
         agent = WorkerAgent(leader_url, host_id=host_id,
                             address=addr_source,
                             tracer=self.container.tracer,

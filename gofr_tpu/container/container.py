@@ -256,6 +256,19 @@ class Container:
                       "(by reason label)")
         m.new_counter("app_fleet_heartbeats",
                       "control-plane heartbeats received")
+        # leader-HA series (serving/control_plane.py): epoch fencing
+        # and worker-driven failover — control-plane cadence only
+        m.new_gauge("app_fleet_leader_epoch",
+                    "this leader's election epoch (bumps on every "
+                    "takeover; workers reject lower-epoch acks)")
+        m.new_counter("app_fleet_failovers",
+                      "worker failover rounds to a new leader "
+                      "(by reason: missed_acks/stale_leader/"
+                      "not_leader)")
+        m.new_counter("app_fleet_stale_leader_rejects",
+                      "control messages refused because they carried "
+                      "a higher epoch than this leader holds (a "
+                      "revived stale leader being fenced)")
         # tenant metering + SLO series, written by the usage ledger /
         # SLO tracker (serving/observability.py) at request retire;
         # tenant-labeled counters SUM across hosts under federation
@@ -323,6 +336,10 @@ class Container:
         m.new_counter("app_router_scale_decisions",
                       "autoscale decisions the router emitted "
                       "(by action label)")
+        m.new_counter("app_router_client_aborts",
+                      "proxied streams cancelled because the "
+                      "downstream client disconnected mid-stream "
+                      "(upstream slot released early)")
 
     # ------------------------------------------------------------- health
     def health(self) -> dict[str, Any]:

@@ -13,11 +13,14 @@ from .client import (
     Retry,
     ServiceError,
     new_http_service,
+    probe_leader,
+    resolve_leader,
 )
 
 __all__ = [
     "APIKeyAuth", "BasicAuth", "CircuitBreaker", "CircuitOpenError",
     "CustomHeaders", "HealthConfig", "HTTPService",
     "OAuth2ClientCredentials", "RateLimit", "RateLimitedError", "Response",
-    "Retry", "ServiceError", "new_http_service",
+    "Retry", "ServiceError", "new_http_service", "probe_leader",
+    "resolve_leader",
 ]

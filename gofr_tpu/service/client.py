@@ -444,3 +444,57 @@ class HTTPService:
 
 def new_http_service(base_url: str, *options: Option, **kw) -> HTTPService:
     return HTTPService(base_url, *options, **kw)
+
+
+# --------------------------------------------------------------- leader
+# discovery (docs/operations.md "Losing the leader"): sync, stdlib-only
+# probes of GET /control/leader so external callers — CLIs, sidecars,
+# the WorkerAgent's failover walk — can re-dial the active front door
+# without DNS churn. Sync on purpose: the walk runs from heartbeat
+# threads and shutdown hooks where spinning an event loop is overkill.
+
+def probe_leader(url: str, *, timeout_s: float = 2.0) -> dict | None:
+    """``GET {url}/control/leader`` and return the leadership doc
+    (``active``, ``epoch``, ``rank``, ``host_id``, ``candidates``,
+    ``converging``) or None when the candidate is unreachable or
+    answers garbage. Never raises — an absent candidate is a normal
+    input to the election, not an error."""
+    import http.client
+    import json as _json
+    from urllib.parse import urlsplit
+    parts = urlsplit(url if "//" in url else "http://" + url)
+    host, port = parts.hostname or "", parts.port or 80
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        conn.request("GET", "/control/leader")
+        resp = conn.getresponse()
+        if resp.status != 200:
+            return None
+        doc = _json.loads(resp.read().decode("utf-8"))
+        data = doc.get("data", doc)
+        return data if isinstance(data, dict) else None
+    except (OSError, ValueError):
+        return None
+    finally:
+        conn.close()
+
+
+def resolve_leader(candidates, *, epoch_at_least: int = -1,
+                   timeout_s: float = 2.0) -> dict | None:
+    """Walk ranked ``candidates`` and return the ACTIVE leader as
+    ``{"url", "rank", **leadership}`` — the highest epoch wins, ties
+    break to the lowest rank, and an active candidate whose epoch is
+    below ``epoch_at_least`` is a revived stale leader and is skipped
+    (the same fencing rule the workers apply). None when no candidate
+    is active."""
+    best = None
+    for rank, url in enumerate(candidates):
+        info = probe_leader(url, timeout_s=timeout_s)
+        if info is None or not info.get("active"):
+            continue
+        epoch = int(info.get("epoch", -1))
+        if epoch < epoch_at_least:
+            continue
+        if best is None or epoch > best["epoch"]:
+            best = dict(info, url=url, rank=rank, epoch=epoch)
+    return best

@@ -45,6 +45,27 @@ elastic-regeneration path instead of waiting out heartbeat silence.
 Workers detect leader loss through the service client's circuit
 breaker and keep retrying with backoff.
 
+Leader high availability (``FleetConfig.leader_candidates``): ranked
+standby leaders share the candidate list; rank 0 boots active at epoch
+1, higher ranks boot standby at epoch 0. Election is **worker-driven
+and deterministic** — a worker that misses
+``missed_acks_before_failover`` heartbeat acks (or sees typed
+``stale_leader`` / ``not_leader`` evidence) probes
+``GET /control/leader`` across the candidates in rank order and elects
+by a pure function of the probe results: the active candidate with the
+highest epoch wins (ties to the lowest rank); with no active candidate
+the lowest-ranked live one is activated by a takeover join at
+``max(epochs seen) + 1``. Epochs are counters — no wall clock, no RNG
+— so every failover drill reproduces under bisect. Every control
+message carries the epoch both ways and both sides **fence**: a leader
+receiving a higher epoch than it holds refuses the write with a typed
+``stale_leader`` 409, counts ``app_fleet_stale_leader_rejects``, and
+demotes itself; a worker receiving a lower-epoch ack rejects it and
+re-discovers. Split-brain is impossible by construction. The new
+leader rebuilds membership, prefix digests, goodput federation and
+routing purely from the next heartbeat round (workers beat immediately
+after a failover join) — no replicated log.
+
 Straggler detection: the leader derives max/median skew of p95 pass
 duration and mean occupancy across members from the heartbeat
 summaries, exposes them as ``app_fleet_pass_skew`` /
@@ -75,8 +96,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Mapping
 
-from ..http.errors import ErrorInvalidParam, HTTPError
-from ..logging.logger import set_fleet_context
+from ..http.errors import (ErrorInvalidParam, ErrorServiceUnavailable,
+                           HTTPError)
+from ..logging.logger import WARN, set_fleet_context
 from ..metrics.registry import merge_snapshots, render_federated
 from .faults import NO_FAULTS, resolve_plan
 
@@ -86,6 +108,23 @@ class StaleGeneration(HTTPError):
     to rejoin (which returns the fresh assignment)."""
 
     status_code = 409
+
+
+class StaleLeader(HTTPError):
+    """Epoch fence: the caller presented a HIGHER epoch than this
+    leader holds, proving a newer leader was elected while this one
+    was away — the write is refused and this leader demotes itself.
+    409, not 503: the conflict is permanent for this epoch, retrying
+    the same leader is pointless (re-discover instead)."""
+
+    status_code = 409
+    log_level = WARN
+
+
+class NotLeader(ErrorServiceUnavailable):
+    """A control or data-plane request hit a standby candidate: a
+    typed 503 whose details carry the epoch and candidate ranks so
+    the caller can walk ``GET /control/leader`` and re-dial."""
 
 
 @dataclass
@@ -104,6 +143,18 @@ class FleetConfig:
     #: health (stall watchdog escalation) instead of waiting for
     #: heartbeat silence
     evict_degraded: bool = True
+    #: ranked leader candidate base URLs for HA; index = rank. Empty
+    #: (the default) is single-leader mode: the one leader is active
+    #: and workers never run the discovery walk
+    leader_candidates: tuple = ()
+    #: convergence budget a takeover advertises to clients (the
+    #: Retry-After on ``leader_takeover`` 503s); response shaping
+    #: only — never an election input
+    leader_lease_s: float = 10.0
+    #: consecutive heartbeat acks a worker may miss before it runs
+    #: the candidate discovery walk (typed stale_leader / not_leader
+    #: evidence fails over immediately, without waiting this out)
+    missed_acks_before_failover: int = 3
 
 
 def engine_fleet_sources(engine: Any) -> tuple[Callable[[], dict],
@@ -195,11 +246,21 @@ _FLEET_GAUGES = (
     ("app_fleet_goodput_ratio",
      "fleet-wide useful device time over busy device time, summed "
      "across member heartbeat goodput digests"),
+    ("app_fleet_leader_epoch",
+     "this leader's election epoch (monotone across failovers; the "
+     "fleet-wide max identifies the active leader)"),
 )
 _FLEET_COUNTERS = (
     ("app_fleet_evictions",
      "hosts evicted from the serving group (by reason label)"),
     ("app_fleet_heartbeats", "control-plane heartbeats received"),
+    ("app_fleet_failovers",
+     "leader failovers observed (by reason label: missed_acks, "
+     "stale_leader, not_leader on workers; takeover on the leader "
+     "that activated)"),
+    ("app_fleet_stale_leader_rejects",
+     "control writes refused by epoch fencing: a revived stale "
+     "leader rejecting (and demoting on) higher-epoch messages"),
 )
 
 
@@ -215,8 +276,10 @@ class ControlPlaneLeader:
                  eviction_misses: int = 3,
                  fleet: FleetConfig | None = None,
                  host_id: str = "",
+                 rank: int = 0,
                  metrics: Any = None,
-                 logger: Any = None) -> None:
+                 logger: Any = None,
+                 faults: Any = None) -> None:
         self.coordinator = coordinator
         self.heartbeat_interval_s = heartbeat_interval_s
         self.eviction_misses = eviction_misses
@@ -224,6 +287,21 @@ class ControlPlaneLeader:
         self.host_id = host_id
         self.metrics = metrics
         self.logger = logger
+        #: deterministic fault plan for the leader-side HA sites
+        #: leader_down / leader_partition / stale_epoch_replay
+        self.faults = resolve_plan(faults)
+        #: this candidate's position in fleet.leader_candidates; rank
+        #: 0 boots active at epoch 1, higher ranks boot standby at
+        #: epoch 0 awaiting a takeover join
+        self.rank = int(rank)
+        self.epoch = 1 if self.rank == 0 else 0
+        self.active = self.rank == 0
+        #: activated-by-takeover and no member heartbeat landed yet:
+        #: the router answers typed leader_takeover 503s until the
+        #: first join converges the rebuilt state (count-based, not
+        #: clock-based — deterministic)
+        self._took_over = False
+        self._stale_rejects = 0
         self.generation = 0
         self._members: dict[str, _Member] = {}
         self._stragglers: set[str] = set()
@@ -257,6 +335,88 @@ class ControlPlaneLeader:
                                float(len(self._members)))
         self.metrics.set_gauge("app_fleet_generation",
                                float(self.generation))
+        self.metrics.set_gauge("app_fleet_leader_epoch",
+                               float(self.epoch))
+
+    # ------------------------------------------------------- leadership
+    def leadership(self) -> dict:
+        """The lease state, consistently snapshotted: served by
+        ``GET /control/leader`` and read by the router's data-plane
+        gate."""
+        with self._lock:
+            return {"active": self.active, "epoch": self.epoch,
+                    "rank": self.rank, "host_id": self.host_id,
+                    "converging": self.active and self._took_over
+                    and not self._members,
+                    "candidates": list(self.fleet.leader_candidates),
+                    "stale_rejects": self._stale_rejects}
+
+    def ensure_active(self, worker_epoch: int = -1) -> bool:
+        """Takeover activation: a worker that lost the old leader
+        elects this candidate by joining with ``takeover``. Activates
+        at ``max(own epoch, worker epoch) + 1`` — strictly above
+        anything either side has seen, so every subsequent control
+        message fences the old leader. Idempotent under concurrent
+        takeover joins: once one wins, later joins with lower or
+        equal evidence see ``active`` with a higher epoch and do not
+        re-bump. Counts and epochs only — no clocks, no RNG."""
+        with self._lock:
+            if self.active and self.epoch > int(worker_epoch):
+                return False  # an earlier takeover already won
+            self.epoch = max(self.epoch, int(worker_epoch)) + 1
+            self.active = True
+            self._took_over = True
+            epoch = self.epoch
+        if self.metrics is not None:
+            self.metrics.set_gauge("app_fleet_leader_epoch",
+                                   float(epoch))
+            self.metrics.increment_counter("app_fleet_failovers",
+                                           reason="takeover")
+        if self.logger:
+            self.logger.warn("standby leader activated by takeover",
+                             epoch=epoch, rank=self.rank)
+        return True
+
+    def _fence(self, worker_epoch: int) -> None:
+        """Epoch fencing for control-plane writes. A request carrying
+        a higher epoch than this leader holds proves a newer leader
+        was elected while this one was away: refuse the write with a
+        typed ``stale_leader`` 409, count it, and demote to standby —
+        a revived old leader can never accept state, so there is no
+        split brain to reconcile. A standby (including an already-
+        demoted leader) refuses non-takeover writes with a typed
+        ``not_leader`` 503 naming the candidate ranks, whatever epoch
+        the caller carries — it never claimed the lease, so there is
+        nothing stale to demote. Callers with no epoch (pre-HA
+        workers) pass -1, which never out-ranks an active leader."""
+        with self._lock:
+            if self.active and worker_epoch > self.epoch:
+                self.active = False
+                self._stale_rejects += 1
+                verdict, epoch = "stale", self.epoch
+            elif not self.active:
+                verdict, epoch = "standby", self.epoch
+            else:
+                return
+        if verdict == "stale":
+            if self.metrics is not None:
+                self.metrics.increment_counter(
+                    "app_fleet_stale_leader_rejects")
+            if self.logger:
+                self.logger.warn(
+                    "stale leader fenced: refusing control write and "
+                    "demoting to standby", epoch=epoch,
+                    caller_epoch=worker_epoch)
+            raise StaleLeader(
+                f"stale leader: caller epoch {worker_epoch} is ahead "
+                f"of this leader's epoch {epoch}",
+                details={"code": "stale_leader", "epoch": epoch})
+        raise NotLeader(
+            "not the active leader; walk GET /control/leader across "
+            "the candidates and re-dial",
+            details={"code": "not_leader", "epoch": epoch,
+                     "candidates": list(self.fleet.leader_candidates)},
+            headers={"Retry-After": "1"})
 
     # ------------------------------------------------------------ state
     def _ranks_locked(self) -> dict[str, int]:
@@ -274,15 +434,23 @@ class ControlPlaneLeader:
             generation=self.generation, coordinator=self.coordinator)
 
     def join(self, host_id: str, address: str, n_devices: int,
-             health: dict | None = None) -> ShardAssignment:
+             health: dict | None = None, *, epoch: int = -1,
+             takeover: bool = False) -> ShardAssignment:
         if not host_id:
             raise ErrorInvalidParam("host_id")
+        if takeover:
+            self.ensure_active(epoch)
+        else:
+            self._fence(epoch)
         with self._lock:
             self.generation += 1  # membership changed for everyone
             self._members[host_id] = _Member(
                 host_id=host_id, address=address,
                 n_devices=max(1, int(n_devices)),
                 last_seen=time.time(), health=dict(health or {}))
+            # first member after a takeover: the rebuilt view is live,
+            # stop answering the data plane with leader_takeover 503s
+            self._took_over = False
             assignment = self._assignment_locked(host_id)
         self._set_membership_gauges()
         if self.logger:
@@ -296,7 +464,7 @@ class ControlPlaneLeader:
                   health: dict | None = None,
                   summary: dict | None = None,
                   metrics_snapshot: dict | None = None,
-                  address: str = ""
+                  address: str = "", epoch: int = -1
                   ) -> tuple[ShardAssignment | None, bool]:
         """-> (assignment, changed): ``changed`` is True when the
         worker's view was stale — its signal to re-coordinate.
@@ -304,6 +472,7 @@ class ControlPlaneLeader:
         evicted (DEGRADED health under ``FleetConfig.evict_degraded``)
         — the route answers with an eviction notice, not a 409, so
         the agent backs off instead of instantly rejoining wedged."""
+        self._fence(epoch)
         degraded = False
         with self._lock:
             member = self._members.get(host_id)
@@ -378,6 +547,8 @@ class ControlPlaneLeader:
             ranks = self._ranks_locked()
             return {
                 "generation": self.generation,
+                "epoch": self.epoch,
+                "active": self.active,
                 "world_size": len(self._members),
                 "members": {
                     m.host_id: {"address": m.address,
@@ -607,6 +778,23 @@ class ControlPlaneLeader:
     def stop(self) -> None:
         self._running = False
 
+    def _trip_leader_faults(self, host_id: str) -> None:
+        """Injected leader failure modes for the HA drills: an armed
+        ``leader_down`` refuses every control RPC, ``leader_partition``
+        refuses only the host named by its ``request=`` tag. Both look
+        like a dead/unreachable leader to the worker (an untyped 503
+        counts as a missed ack), never like a typed refusal."""
+        if self.faults is NO_FAULTS:
+            return
+        if self.faults.trip("leader_down"):
+            raise ErrorServiceUnavailable(
+                "leader down (injected)",
+                details={"code": "leader_down"})
+        if self.faults.trip("leader_partition", request_id=host_id):
+            raise ErrorServiceUnavailable(
+                "leader partitioned from host (injected)",
+                details={"code": "leader_partition"})
+
     # ------------------------------------------------------------ routes
     def install(self, app: Any) -> None:
         """Register the control routes and start the sweeper when the
@@ -617,6 +805,7 @@ class ControlPlaneLeader:
         if self.metrics is None:
             self.metrics = app.container.metrics
             self._register_metrics(self.metrics)
+        self._set_membership_gauges()  # leader epoch visible from boot
         if self.host_id:
             # leader-side half of cross-host correlation: every leader
             # log/span names the host it ran on
@@ -625,32 +814,46 @@ class ControlPlaneLeader:
         @app.post("/control/join")
         def join(ctx):
             body = ctx.bind() or {}
+            self._trip_leader_faults(str(body.get("host_id", "")))
             assignment = self.join(
                 str(body.get("host_id", "")),
                 str(body.get("address", "")),
-                int(body.get("n_devices", 1)),
-                body.get("health"))
+                _body_int(body, "n_devices", 1),
+                body.get("health"),
+                epoch=_body_int(body, "epoch", -1),
+                takeover=bool(body.get("takeover", False)))
             # the assignment's generation, not a re-read of
             # self.generation: a concurrent join may have bumped it
             return {"generation": assignment.generation,
-                    "assignment": assignment.to_dict()}
+                    "assignment": assignment.to_dict(),
+                    "epoch": self.epoch}
 
         @app.post("/control/heartbeat")
         def heartbeat(ctx):
             body = ctx.bind() or {}
+            self._trip_leader_faults(str(body.get("host_id", "")))
             assignment, changed = self.heartbeat(
                 str(body.get("host_id", "")),
-                int(body.get("generation", -1)),
+                _body_int(body, "generation", -1),
                 body.get("health"),
                 body.get("summary"),
                 body.get("metrics") if self.fleet.federation else None,
-                address=str(body.get("address", "")))
+                address=str(body.get("address", "")),
+                epoch=_body_int(body, "epoch", -1))
+            epoch_out = self.epoch
+            if self.faults is not NO_FAULTS \
+                    and self.faults.trip("stale_epoch_replay"):
+                # injected replayed/rolled-back ack: the worker-side
+                # fence must reject it and re-discover
+                epoch_out = max(0, epoch_out - 1)
             if assignment is None:  # evicted on this very heartbeat
                 return {"ok": False, "evicted": True,
-                        "generation": self.generation}
+                        "generation": self.generation,
+                        "epoch": epoch_out}
             return {"ok": True, "changed": changed,
                     "generation": assignment.generation,
-                    "assignment": assignment.to_dict()}
+                    "assignment": assignment.to_dict(),
+                    "epoch": epoch_out}
 
         @app.post("/control/leave")
         def leave(ctx):
@@ -661,8 +864,30 @@ class ControlPlaneLeader:
             host_id = str(body.get("host_id", ""))
             if not host_id:
                 raise ErrorInvalidParam("host_id")
+            self._trip_leader_faults(host_id)
+            self._fence(_body_int(body, "epoch", -1))
             self.evict(host_id, reason="leave")
-            return {"ok": True, "generation": self.generation}
+            return {"ok": True, "generation": self.generation,
+                    "epoch": self.epoch}
+
+        @app.get("/control/leader")
+        def leader_info(ctx):
+            # discovery, safe on any candidate active or standby: the
+            # redirect contract is "probe the ranked candidates, dial
+            # the active one with the highest epoch" — workers, the
+            # service client's resolve_leader, and operators all walk
+            # the same door (docs/operations.md "Losing the leader").
+            # An injected leader_down refuses probes too — a down
+            # leader must look dead to the discovery walk (a
+            # partition stays asymmetric: probes carry no host_id)
+            if self.faults is not NO_FAULTS \
+                    and self.faults.trip("leader_down"):
+                raise ErrorServiceUnavailable(
+                    "leader down (injected)",
+                    details={"code": "leader_down"})
+            info = self.leadership()
+            info["heartbeat_interval_s"] = self.heartbeat_interval_s
+            return info
 
         @app.get("/control/topology")
         def topology(ctx):
@@ -688,11 +913,43 @@ class ControlPlaneLeader:
         app.on_shutdown(self.stop)
 
 
+def _body_int(body: Mapping[str, Any], key: str, default: int) -> int:
+    """Optional integer field of a control-plane body: absent takes
+    the default, garbage draws a typed 400 naming the field instead
+    of surfacing as an internal error."""
+    value = body.get(key, default)
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        raise ErrorInvalidParam(key)
+
+
+def _typed_reject(response) -> tuple[str, dict]:
+    """Pull the typed error code + details out of a control-plane
+    reject (the ``{"error": {"message", "details"}}`` envelope).
+    Unparseable bodies degrade to ``("", {})`` — the caller falls back
+    on status-code semantics."""
+    try:
+        doc = response.json() or {}
+        details = ((doc.get("error") or {}).get("details") or {})
+        return str(details.get("code") or ""), details
+    except (ValueError, AttributeError, TypeError):
+        return "", {}
+
+
 class WorkerAgent:
     """A serving host's side of the protocol: join once, heartbeat on a
     thread, and invoke ``on_assignment`` every time the generation
     changes — the hook where the host tears down and relaunches its
-    SPMD program with the new rank/world (elastic restart)."""
+    SPMD program with the new rank/world (elastic restart).
+
+    With a multi-candidate ``FleetConfig.leader_candidates`` the agent
+    also runs the HA failover protocol: count missed heartbeat acks,
+    and after ``missed_acks_before_failover`` of them walk the ranked
+    candidates (``GET /control/leader``), elect deterministically
+    (:meth:`_choose_candidate` — counts/epochs only, no clocks, no
+    RNG), re-dial, and takeover-join so the winner rebuilds its state
+    from this worker's very next heartbeat."""
 
     def __init__(self, leader_url: str, *, host_id: str,
                  address: str | Callable[[], str] = "",
@@ -707,9 +964,11 @@ class WorkerAgent:
                  join_backoff_max_s: float = 30.0,
                  tracer: Any = None,
                  logger: Any = None, service: Any = None,
-                 faults: Any = None) -> None:
+                 faults: Any = None,
+                 metrics: Any = None) -> None:
         from ..service import CircuitBreaker, Retry, new_http_service
         self.host_id = host_id
+        self.leader_url = leader_url
         #: dial address advertised to the leader; a callable is
         #: re-resolved on every join/heartbeat — how ephemeral-port
         #: workers advertise an endpoint they only learn after their
@@ -734,10 +993,30 @@ class WorkerAgent:
         self.fleet = fleet if fleet is not None else FleetConfig()
         self.tracer = tracer
         self.logger = logger
+        self._service_injected = service is not None
         self._service = service if service is not None else \
             new_http_service(leader_url, Retry(max_retries=2),
                              CircuitBreaker(threshold=5, interval_s=2.0),
                              logger=logger, tracer=tracer)
+        #: worker-side metrics manager (App.join_fleet wires the
+        #: container's) — app_fleet_failovers rides it
+        self.metrics = metrics
+        if metrics is not None:
+            ControlPlaneLeader._register_metrics(metrics)
+        #: ranked leader candidates for the HA discovery walk; a
+        #: single-URL tuple (no failover machinery) when unset
+        self.candidates: tuple = \
+            tuple(self.fleet.leader_candidates) or (leader_url,)
+        self.missed_acks_before_failover = max(
+            1, int(self.fleet.missed_acks_before_failover))
+        #: highest leader epoch this worker has observed; sent on
+        #: every control message, and acks carrying a LOWER epoch are
+        #: rejected (worker-side fencing of revived stale leaders)
+        self.epoch = 0
+        self._missed_acks = 0
+        self._electing = False  # reentrancy guard on the walk
+        #: failover rounds by reason (tests + debug surfaces)
+        self.failovers: dict[str, int] = {}
         self.assignment: ShardAssignment | None = None
         self._running = False
         self._leaving = False  # deregistered: suppress auto-rejoin
@@ -783,9 +1062,18 @@ class WorkerAgent:
         finally:
             if span is not None:
                 span.end()
-        if response.status == 409:
-            return {"rejoin": True}
         if response.status >= 400:
+            code, details = _typed_reject(response)
+            if response.status == 409:
+                if code == "stale_leader":
+                    # the dialed leader is FENCED: it saw our higher
+                    # epoch and demoted — re-discover, don't rejoin it
+                    return {"stale_leader": True,
+                            "leader_epoch": int(details.get("epoch", -1))}
+                return {"rejoin": True}
+            if code in ("not_leader", "leader_takeover"):
+                return {"not_leader": True,
+                        "leader_epoch": int(details.get("epoch", -1))}
             raise RuntimeError(
                 f"control plane {path} -> {response.status}")
         data = response.json()
@@ -826,19 +1114,156 @@ class WorkerAgent:
                 return ""
         return str(addr or "")
 
-    def join(self) -> ShardAssignment:
+    def join(self, takeover: bool = False) -> ShardAssignment:
         if self.faults is not NO_FAULTS \
                 and self.faults.trip("join_refused"):
             # injected leader refusal: exercises the join-retry backoff
             raise RuntimeError("control-plane join refused (injected)")
-        payload = self._post("/control/join", {
+        body: dict[str, Any] = {
             "host_id": self.host_id,
             "address": self.advertised_address(),
             "n_devices": self.n_devices,
-            "health": self.health_source()})
+            "health": self.health_source(),
+            "epoch": self.epoch}
+        if takeover:
+            body["takeover"] = True
+        payload = self._post("/control/join", body)
+        if payload.get("not_leader") or payload.get("stale_leader"):
+            raise RuntimeError(
+                "control-plane join refused: not the active leader")
+        if not self._adopt_epoch(payload):
+            raise RuntimeError(
+                "control-plane join answered with a stale epoch")
         self._apply(payload)
         assert self.assignment is not None
         return self.assignment
+
+    # ------------------------------------------------- leader discovery
+    def _adopt_epoch(self, payload: dict) -> bool:
+        """Worker-side epoch fencing: adopt the leader's epoch from an
+        ack, or reject the ack when it carries a LOWER epoch than this
+        worker has already seen — a revived stale leader, or an
+        injected ``stale_epoch_replay``. Counts only, no clocks."""
+        raw = payload.get("epoch")
+        if raw is None:
+            return True  # pre-HA leader: no epochs on the wire
+        epoch = int(raw)
+        if epoch < self.epoch:
+            return False
+        self.epoch = epoch
+        return True
+
+    def _note_missed_ack(self) -> None:
+        self._missed_acks += 1
+        if self._missed_acks >= self.missed_acks_before_failover \
+                and len(self.candidates) > 1:
+            self._failover("missed_acks")
+
+    def _failover(self, reason: str) -> bool:
+        """One failover round: count it (``app_fleet_failovers``),
+        then run the discovery walk. Reentrancy-guarded — the
+        immediate post-join heartbeat inside the walk must not
+        recurse into another round."""
+        if self._electing:
+            return False
+        self._missed_acks = 0
+        self.failovers[reason] = self.failovers.get(reason, 0) + 1
+        if self.metrics is not None:
+            self.metrics.increment_counter("app_fleet_failovers",
+                                           reason=reason)
+        if self.logger:
+            self.logger.warn("leader failover triggered", reason=reason,
+                             host=self.host_id, epoch=self.epoch)
+        return self._locate_leader()
+
+    def _probe_candidates(self) -> list[dict]:
+        """``GET /control/leader`` on every candidate, in rank order.
+        Unreachable candidates are simply absent from the result."""
+        from ..service.client import probe_leader
+        probes = []
+        for rank, url in enumerate(self.candidates):
+            info = probe_leader(
+                url, timeout_s=max(1.0, self.heartbeat_interval_s))
+            if info is None:
+                continue
+            probes.append({"rank": rank, "url": url,
+                           "active": bool(info.get("active")),
+                           "epoch": int(info.get("epoch", -1))})
+        return probes
+
+    @staticmethod
+    def _choose_candidate(probes: list, known_epoch: int):
+        """THE election decision — a pure function of the probe
+        results and the worker's known epoch (TestElectionContract
+        pins that it reads no clock and no RNG). Prefer the live
+        active candidate with the highest epoch at or above what we
+        know (ties break to the lowest rank; an active candidate
+        BELOW the known epoch is a revived stale leader and is never
+        adopted); with no acceptable active candidate, elect the
+        lowest-ranked live one via a takeover join. Returns
+        ``(url, takeover)`` or None when nothing is reachable."""
+        active = [p for p in probes
+                  if p["active"] and p["epoch"] >= known_epoch]
+        if active:
+            best = min(active, key=lambda p: (-p["epoch"], p["rank"]))
+            return best["url"], False
+        if probes:
+            lowest = min(probes, key=lambda p: p["rank"])
+            return lowest["url"], True
+        return None
+
+    def _redial(self, url: str) -> None:
+        if self._service_injected:
+            return  # tests inject a transport; keep it
+        if url.rstrip("/") == self.leader_url.rstrip("/"):
+            return
+        from ..service import CircuitBreaker, Retry, new_http_service
+        self.leader_url = url
+        self._service = new_http_service(
+            url, Retry(max_retries=2),
+            CircuitBreaker(threshold=5, interval_s=2.0),
+            logger=self.logger, tracer=self.tracer)
+
+    def _locate_leader(self) -> bool:
+        """Discovery walk + (re)join: probe the ranked candidates,
+        elect deterministically, re-dial and join, then heartbeat
+        immediately so the winner rebuilds its membership/digest/
+        routing state from this worker NOW instead of one interval
+        later (the stateless-rebuild takeover). Reentrancy-guarded:
+        the immediate post-join heartbeat must not recurse into
+        another walk."""
+        if self._electing:
+            return False
+        self._electing = True
+        try:
+            probes = self._probe_candidates()
+            while probes:
+                choice = self._choose_candidate(probes, self.epoch)
+                assert choice is not None  # probes is non-empty
+                url, takeover = choice
+                self._redial(url)
+                try:
+                    self.join(takeover=takeover)
+                except Exception as exc:
+                    # refused (asymmetric partition, injected refusal,
+                    # raced a shutdown): strike THIS candidate and
+                    # re-elect among the rest — deterministic, the
+                    # probe list only shrinks
+                    if self.logger:
+                        self.logger.warn(
+                            f"failover join to {url} failed: {exc}")
+                    probes = [p for p in probes if p["url"] != url]
+                    continue
+                if self.logger:
+                    self.logger.info(
+                        "failed over to new leader", url=url,
+                        epoch=self.epoch, takeover=takeover,
+                        host=self.host_id)
+                self._heartbeat_once()
+                return True
+            return False
+        finally:
+            self._electing = False
 
     def heartbeat_sync(self) -> tuple[ShardAssignment | None, bool]:
         """One synchronous heartbeat; returns (assignment, changed).
@@ -852,6 +1277,8 @@ class WorkerAgent:
         return self.assignment, after != before
 
     def _heartbeat_once(self) -> None:
+        if self._leaving:
+            return  # departing: the leave walk owns the wire now
         if self.faults is not NO_FAULTS \
                 and self.faults.trip("heartbeat_drop"):
             return  # injected lossy control network: skip this beat
@@ -859,7 +1286,7 @@ class WorkerAgent:
                       if self.assignment is not None else -1)
         body: dict[str, Any] = {
             "host_id": self.host_id, "generation": generation,
-            "health": self.health_source()}
+            "health": self.health_source(), "epoch": self.epoch}
         addr = self.advertised_address()
         if addr:
             body["address"] = addr
@@ -879,10 +1306,32 @@ class WorkerAgent:
             payload = self._post("/control/heartbeat", body)
         except Exception as exc:
             # leader unreachable: the circuit breaker is already
-            # backing off — keep the last assignment and keep serving
+            # backing off — keep the last assignment and keep serving,
+            # but COUNT the miss: enough of them in a row triggers the
+            # failover walk (multi-candidate fleets only)
             if self.logger:
                 self.logger.warn(f"control-plane heartbeat failed: {exc}")
+            self._note_missed_ack()
             return
+        if self.faults is not NO_FAULTS \
+                and self.faults.trip("ack_drop"):
+            # injected one-way loss: the leader saw the beat, the
+            # worker never hears the ack — counts as a miss here
+            self._note_missed_ack()
+            return
+        if payload.get("stale_leader") or not self._adopt_epoch(payload):
+            # the dialed leader is behind our epoch (revived stale
+            # leader, or an injected stale_epoch_replay): typed
+            # evidence of staleness — fail over immediately, no
+            # missed-ack budget needed
+            self._failover("stale_leader")
+            return
+        if payload.get("not_leader"):
+            # a standby answered: it told us so with a typed 503 —
+            # re-discover the active leader immediately
+            self._failover("not_leader")
+            return
+        self._missed_acks = 0
         if payload.get("evicted"):
             # the leader acted on our DEGRADED gossip: drop the
             # assignment and do NOT auto-rejoin until health clears
@@ -894,7 +1343,9 @@ class WorkerAgent:
                     "evicted by leader on degraded health; will "
                     "rejoin when healthy", host=self.host_id)
             return
-        if payload.get("rejoin"):
+        if payload.get("rejoin") and not self._leaving:
+            # never re-adopt a departing worker from a stale heartbeat
+            # racing its own /control/leave
             try:
                 self.join()
             except Exception as exc:
@@ -941,7 +1392,14 @@ class WorkerAgent:
                     if not self._healthy():
                         continue  # evicted-degraded: heal first
                     try:
-                        self.join()
+                        if len(self.candidates) > 1:
+                            # HA fleet: discovery walk instead of a
+                            # blind re-dial of a possibly-dead leader
+                            if not self._locate_leader():
+                                raise RuntimeError(
+                                    "no live leader candidate")
+                        else:
+                            self.join()
                         backoff = base
                     except Exception as exc:
                         backoff = min(backoff * 2.0,
@@ -958,22 +1416,51 @@ class WorkerAgent:
                                         name=f"worker-{self.host_id}")
         self._thread.start()
 
-    def deregister(self) -> None:
+    def deregister(self, rounds: int | None = None) -> bool:
         """Graceful leave (the SIGTERM drain path): tell the leader
         this host is going away NOW — survivors re-rank immediately
         instead of waiting out heartbeat silence. Best-effort: a dead
         leader must never block shutdown. Clears the assignment so the
-        heartbeat thread does not immediately rejoin."""
+        heartbeat thread does not immediately rejoin.
+
+        In a multi-candidate fleet the leave survives a takeover
+        window: when the dialed leader is down or answers with a
+        typed ``not_leader``/``stale_leader`` reject, the agent
+        re-probes the candidates and retries against whoever is
+        active NOW — but never takeover-joins (a departing worker
+        must not elect a leader on its way out). Returns True when a
+        leader acknowledged the leave."""
         self._leaving = True
         self.assignment = None
-        try:
-            self._post("/control/leave", {"host_id": self.host_id})
-            if self.logger:
-                self.logger.info("deregistered from serving group",
-                                 host=self.host_id)
-        except Exception as exc:
-            if self.logger:
-                self.logger.warn(f"control-plane leave failed: {exc}")
+        body = {"host_id": self.host_id, "epoch": self.epoch}
+        if rounds is None:
+            rounds = max(1, self.missed_acks_before_failover)
+        for attempt in range(rounds):
+            try:
+                payload = self._post("/control/leave", body)
+            except Exception as exc:
+                payload = {"error": str(exc)}
+            if not (payload.get("not_leader") or payload.get("stale_leader")
+                    or payload.get("error")):
+                self._adopt_epoch(payload)
+                if self.logger:
+                    self.logger.info("deregistered from serving group",
+                                     host=self.host_id)
+                return True
+            if attempt + 1 >= rounds:
+                break
+            # a takeover may be mid-flight: give the election one
+            # heartbeat interval, then re-discover the front door
+            time.sleep(self.heartbeat_interval_s)
+            if len(self.candidates) > 1:
+                choice = self._choose_candidate(
+                    self._probe_candidates(), self.epoch)
+                if choice is not None and not choice[1]:
+                    self._redial(choice[0])
+        if self.logger:
+            self.logger.warn("control-plane leave failed",
+                             host=self.host_id)
+        return False
 
     def stop(self) -> None:
         self._running = False

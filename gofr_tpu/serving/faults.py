@@ -31,6 +31,18 @@ view):
                           simulates a lossy control network
 ``join_refused``          ``WorkerAgent.join()`` raises — simulates a leader
                           that is down or rejecting, exercising join backoff
+``leader_down``           the leader answers every control RPC with a 503 —
+                          simulates a dead front door without killing the
+                          process; drives the missed-ack failover path
+``leader_partition``      the leader refuses control RPCs from one host
+                          (``request=host_id``) — an asymmetric network
+                          partition: only that host elects
+``ack_drop``              ``WorkerAgent`` discards a *successful* heartbeat
+                          ack — the leader saw the beat, the worker counts a
+                          miss; exercises one-way control-network loss
+``stale_epoch_replay``    the leader answers a heartbeat with ``epoch - 1``
+                          — a replayed/stale ack; exercises worker-side
+                          epoch fencing (the ack must be rejected)
 ========================  =====================================================
 
 The disabled plan is the module-level :data:`NO_FAULTS` singleton; call
@@ -64,6 +76,7 @@ from ..analysis.annotations import hot_path_boundary
 SITES = frozenset({
     "pass_raise", "pass_stall", "pass_latency", "page_exhaustion",
     "nan_logits", "heartbeat_drop", "join_refused",
+    "leader_down", "leader_partition", "ack_drop", "stale_epoch_replay",
 })
 
 # sites whose firing is a raise vs. a sleep; the rest report True and
@@ -161,7 +174,10 @@ class FaultPlan:
     @classmethod
     def parse(cls, text: str) -> "FaultPlan":
         """Parse ``site[:k=v[,k=v...]][;site...]`` (module docstring).
-        An empty/blank string parses to :data:`NO_FAULTS`."""
+        An empty/blank string parses to :data:`NO_FAULTS`. Malformed
+        plans fail loudly with the offending token in the message —
+        a typo'd ``GOFR_FAULTS`` silently arming nothing would make a
+        chaos drill vacuously green."""
         text = (text or "").strip()
         if not text:
             return NO_FAULTS
@@ -169,23 +185,42 @@ class FaultPlan:
         for clause in text.split(";"):
             clause = clause.strip()
             if not clause:
-                continue
+                raise ValueError(
+                    f"empty fault clause (stray ';') in {text!r}")
             site, _, argstr = clause.partition(":")
             site = site.strip()
+            if not site:
+                raise ValueError(
+                    f"bad fault clause {clause!r}: missing site name "
+                    f"before ':'; valid sites: {', '.join(sorted(SITES))}")
+            if site not in SITES:
+                raise ValueError(
+                    f"unknown fault site {site!r} in clause {clause!r}; "
+                    f"valid sites: {', '.join(sorted(SITES))}")
             kw: dict = {}
             for pair in filter(None, (p.strip() for p in argstr.split(","))):
                 key, sep, val = pair.partition("=")
                 key = key.strip()
                 if not sep or key not in ("at", "times", "seconds", "request"):
                     raise ValueError(
-                        f"bad fault clause {clause!r}: expected "
+                        f"bad fault clause {clause!r}: {pair!r} is not "
                         "key=value with key in at/times/seconds/request")
                 if key == "request":
                     kw[key] = val.strip()
                 elif key == "seconds":
-                    kw[key] = float(val)
+                    try:
+                        kw[key] = float(val)
+                    except ValueError:
+                        raise ValueError(
+                            f"bad fault clause {clause!r}: seconds "
+                            f"expects a number, got {val!r}") from None
                 else:
-                    kw[key] = int(val)
+                    try:
+                        kw[key] = int(val)
+                    except ValueError:
+                        raise ValueError(
+                            f"bad fault clause {clause!r}: {key} "
+                            f"expects an integer, got {val!r}") from None
             if kw.get("at", 1) < 1:
                 raise ValueError(f"bad fault clause {clause!r}: at >= 1")
             specs.append(FaultSpec(site=site, **kw))

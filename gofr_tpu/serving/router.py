@@ -161,6 +161,9 @@ _ROUTER_COUNTERS = (
      "requests routed by session affinity"),
     ("app_router_scale_decisions",
      "autoscale decisions emitted (by action label)"),
+    ("app_router_client_aborts",
+     "proxied streams cancelled because the downstream client "
+     "disconnected mid-stream (upstream slot released early)"),
 )
 
 
@@ -347,6 +350,7 @@ class FleetRouter:
         self._routed_cache_hits = 0
         self._affinity_hits = 0
         self._retries = 0
+        self._client_aborts = 0
         self._rr_next = 0
         self._autoscale_tick = -float("inf")
         if hasattr(leader, "add_evict_listener"):
@@ -531,7 +535,60 @@ class FleetRouter:
         proxy.__name__ = f"route_{path.strip('/').replace('/', '_')}"
         return proxy
 
+    def _leadership_gate(self) -> None:
+        """HA fence on the data plane: a standby leader must not route
+        (clients get a typed ``not_leader`` 503 naming the candidates
+        to re-dial — see GET /control/leader), and a fresh takeover
+        serves typed retryable ``leader_takeover`` 503s until the
+        first heartbeat round rebuilds the routing table — the client
+        retry honoring Retry-After is what keeps greedy outputs
+        bit-identical through a failover."""
+        lead = getattr(self.leader, "leadership", None)
+        if lead is None:
+            return  # non-HA leader (or test fake): nothing to gate
+        state = lead()
+        from ..http.errors import ErrorServiceUnavailable
+        if not state.get("active", True):
+            raise ErrorServiceUnavailable(
+                "this leader is a standby; re-resolve the active "
+                "leader via GET /control/leader",
+                details={"code": "not_leader",
+                         "epoch": state.get("epoch", 0),
+                         "candidates": state.get("candidates", [])},
+                headers={"Retry-After": "1"})
+        if state.get("converging"):
+            interval = getattr(getattr(self.leader, "fleet", None),
+                               "heartbeat_interval_s", 1.0)
+            raise ErrorServiceUnavailable(
+                "leader takeover in progress; routing state rebuilds "
+                "from the next heartbeat round",
+                details={"code": "leader_takeover",
+                         "epoch": state.get("epoch", 0)},
+                headers={"Retry-After":
+                         str(max(1, round(float(interval))))})
+
+    async def _abort_watch(self, upstream):
+        """Client-abort propagation: when the downstream client
+        disconnects mid-stream the HTTP server closes this generator
+        (GeneratorExit); close the upstream iterator NOW — its
+        ``finally`` tears the worker connection down, releasing the
+        decode slot — instead of draining tokens nobody will read."""
+        try:
+            async for chunk in upstream:
+                yield chunk
+        except GeneratorExit:
+            with self._lock:
+                self._client_aborts += 1
+            if self.metrics is not None:
+                self.metrics.increment_counter("app_router_client_aborts")
+            if self.logger:
+                self.logger.info(
+                    "client disconnected mid-stream; cancelled upstream")
+            await upstream.aclose()
+            raise
+
     async def proxy_request(self, ctx, path: str) -> ResponseData:
+        self._leadership_gate()
         request = ctx.request
         raw_body = getattr(request, "body", b"") or b""
         try:
@@ -596,8 +653,9 @@ class FleetRouter:
                 return ResponseData(
                     status=status, content_type=ctype,
                     headers=_mirror_headers(uhdrs),
-                    stream=_iter_body(reader, writer, uhdrs,
-                                      self.config.read_timeout_s))
+                    stream=self._abort_watch(
+                        _iter_body(reader, writer, uhdrs,
+                                   self.config.read_timeout_s)))
             payload = await _read_all(reader, writer, uhdrs,
                                       self.config.read_timeout_s)
             return _mirror(status, uhdrs, payload)
@@ -608,6 +666,7 @@ class FleetRouter:
     async def models_proxy(self, ctx) -> ResponseData:
         """GET /v1/models passthrough to the first healthy member (the
         model list is identical fleet-wide)."""
+        self._leadership_gate()
         for m in self._members():
             try:
                 status, uhdrs, reader, writer = await _open_upstream(
@@ -634,6 +693,7 @@ class FleetRouter:
             hits = self._routed_cache_hits
             affinity_hits = self._affinity_hits
             retries = self._retries
+            aborts = self._client_aborts
         out = {
             "policy": self.config.policy,
             "routed": routed,
@@ -642,6 +702,7 @@ class FleetRouter:
             "affinity": {**self.affinity.state(),
                          "hits": affinity_hits},
             "retries": retries,
+            "client_aborts": aborts,
         }
         if self.autoscaler is not None:
             out["autoscale"] = self.autoscaler.state()

@@ -1,0 +1,29 @@
+"""election contract: violations — unlocked lease-state mutations and
+clock/RNG-driven election decisions (nondeterministic failover)."""
+import random
+import time
+import threading
+
+
+class Lease:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.epoch = 0
+        self.active = False
+
+    def activate(self, worker_epoch):
+        with self._lock:
+            self.epoch = max(self.epoch, worker_epoch) + 1
+            self.active = True          # establishes: lease state locked
+
+    def racy_demote(self):
+        self.active = False             # L20: unlocked assignment
+        self.epoch = self.epoch - 1     # L21: unlocked assignment
+
+    def choose(self, probes):
+        # wall-clock tiebreak + RNG pick: the same probe list elects a
+        # different leader on every run — a failover drill that cannot
+        # reproduce under bisect (TestElectionContract bans both)
+        if int(time.time()) % 2:
+            return probes[0]
+        return random.choice(probes)

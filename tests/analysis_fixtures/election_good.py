@@ -1,0 +1,28 @@
+"""election contract: clean twin — every lease mutation holds the
+lock, and the election is a pure function of ranks and epochs."""
+import threading
+
+
+class Lease:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.epoch = 0
+        self.active = False
+
+    def activate(self, worker_epoch):
+        with self._lock:
+            self.epoch = max(self.epoch, worker_epoch) + 1
+            self.active = True
+
+    def demote(self):
+        with self._lock:
+            self.active = False
+
+    @staticmethod
+    def choose(probes, known_epoch):
+        # counts and epochs only: deterministic for a given probe list
+        live = [p for p in probes
+                if p["active"] and p["epoch"] >= known_epoch]
+        if live:
+            return min(live, key=lambda p: (-p["epoch"], p["rank"]))
+        return min(probes, key=lambda p: p["rank"]) if probes else None

@@ -176,6 +176,66 @@ class TestLockDiscipline:
         assert violations(lint("locks_good.py"), "lock-discipline") == []
 
 
+# ------------------------------------------------------------- election
+class TestElectionContract:
+    """Leader-HA determinism contract (docs/operations.md "Losing the
+    leader"): lease state (epoch/active) mutates only under the lock,
+    and election/fencing decisions are pure functions of counts and
+    epochs — no wall clock, no RNG — so every failover drill
+    reproduces under bisect."""
+
+    #: the election/fencing decision functions in the live module
+    ELECTION_FNS = ("ensure_active", "_fence", "_choose_candidate",
+                    "_adopt_epoch")
+
+    def test_bad_fixture_lease_races_are_flagged(self):
+        got = violations(lint("election_bad.py"), "lock-discipline")
+        assert {f.line for f in got} == {20, 21}
+        assert any("active" in f.message for f in got)
+        assert any("epoch" in f.message for f in got)
+
+    def test_bad_fixture_election_reads_clock_and_rng(self):
+        # what the contract bans, demonstrated: the bad twin's choose()
+        # references time and random
+        names = self._referenced_modules(
+            FIXTURES / "election_bad.py", ("choose",))
+        assert {"time", "random"} <= names
+
+    def test_clean_twin_is_silent_and_pure(self):
+        assert violations(lint("election_good.py"),
+                          "lock-discipline") == []
+        names = self._referenced_modules(
+            FIXTURES / "election_good.py", ("choose",))
+        assert not names & {"time", "random"}
+
+    def test_live_election_functions_are_clock_and_rng_free(self):
+        src = REPO / "gofr_tpu" / "serving" / "control_plane.py"
+        names = self._referenced_modules(src, self.ELECTION_FNS)
+        assert not names & {"time", "random"}, (
+            f"election/fencing logic reads a clock or RNG: {names}")
+
+    def test_live_module_lints_clean(self):
+        src = REPO / "gofr_tpu" / "serving" / "control_plane.py"
+        findings, _ = run_analysis([src], root=REPO)
+        assert violations(findings, "lock-discipline") == []
+
+    @staticmethod
+    def _referenced_modules(path, fn_names):
+        """Module names used as ``mod.attr(...)`` inside the named
+        functions of ``path`` (any nesting depth)."""
+        import ast
+        tree = ast.parse(path.read_text())
+        out: set = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in fn_names:
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Attribute) \
+                            and isinstance(sub.value, ast.Name):
+                        out.add(sub.value.id)
+        return out
+
+
 # ---------------------------------------------------------------- async
 class TestBlockingInAsync:
     def test_bad_fixture(self):

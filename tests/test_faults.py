@@ -65,6 +65,42 @@ class TestFaultPlan:
         with pytest.raises(TypeError):
             resolve_plan(42)
 
+    def test_unknown_site_error_names_token_and_valid_sites(self):
+        # a typo'd GOFR_FAULTS silently arming nothing would make a
+        # chaos drill vacuously green — the message must hand the
+        # operator the bad token AND the menu
+        with pytest.raises(ValueError) as err:
+            FaultPlan.parse("pass_raise:at=2;leeder_down")
+        msg = str(err.value)
+        assert "'leeder_down'" in msg
+        assert "leader_down" in msg          # the valid-site list
+        assert "pass_raise" in msg
+
+    def test_stray_semicolon_is_rejected(self):
+        with pytest.raises(ValueError, match="stray ';'"):
+            FaultPlan.parse("pass_raise:at=2;")
+        with pytest.raises(ValueError, match="stray ';'"):
+            FaultPlan.parse(";pass_raise")
+        with pytest.raises(ValueError, match="stray ';'"):
+            FaultPlan.parse("pass_raise;;heartbeat_drop")
+
+    def test_missing_site_name_is_rejected(self):
+        with pytest.raises(ValueError, match="missing site name"):
+            FaultPlan.parse(":at=2")
+
+    def test_bad_pair_errors_name_the_offending_token(self):
+        # not key=value at all
+        with pytest.raises(ValueError, match=r"'at'.*key=value"):
+            FaultPlan.parse("pass_raise:at")
+        # unknown key, named in the clause
+        with pytest.raises(ValueError, match=r"'when=3'"):
+            FaultPlan.parse("pass_raise:when=3")
+        # non-numeric payloads name the value they choked on
+        with pytest.raises(ValueError, match=r"integer.*'soon'"):
+            FaultPlan.parse("pass_raise:at=soon")
+        with pytest.raises(ValueError, match=r"number.*'fast'"):
+            FaultPlan.parse("pass_stall:seconds=fast")
+
     def test_env_resolution(self, monkeypatch):
         monkeypatch.setenv("GOFR_FAULTS", "pass_raise:at=7")
         plan = plan_from_env()

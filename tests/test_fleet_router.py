@@ -520,6 +520,45 @@ class TestLiveProxy:
         assert result["body"].rstrip().endswith("data: [DONE]")
         assert w1.app._test_state["hits"] == hits_before
 
+    def test_client_abort_cancels_upstream_and_counts(self, fleet):
+        """Satellite: client-abort propagation. The downstream client
+        half-closes its socket mid-stream; the next chunk write fails,
+        the router closes the proxied upstream instead of draining it,
+        and ``app_router_client_aborts`` counts the abort. Event-gated
+        and deadline-polled — no fixed sleeps."""
+        leader, w1, w2 = fleet
+        leader.app._leader.router.affinity.put("s", "w1")
+        conn = http.client.HTTPConnection("127.0.0.1", leader.port,
+                                          timeout=30)
+        conn.request("POST", "/chat",
+                     body=json.dumps({"prompt": "x", "stream": True,
+                                      "session": "s"}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert w1.app._test_state["started"].wait(10)
+        # the client walks away after the first chunk
+        conn.sock.recv(1)  # ensure the first write landed
+        conn.close()
+        # unblock the worker: the router's NEXT chunk write hits the
+        # dead client socket and must cancel the upstream
+        w1.app._test_state["release"].set()
+        router = leader.app._leader.router
+        deadline = threading.Event()
+        for _ in range(1000):
+            if router.debug_state()["client_aborts"] >= 1:
+                break
+            deadline.wait(0.01)
+        assert router.debug_state()["client_aborts"] == 1
+        # the abort rode the metrics surface too
+        status, _, text = leader.request("GET", "/metrics",
+                                         port=leader.metrics_port)
+        assert status == 200
+        assert "app_router_client_aborts 1" in text.decode()
+        # the fleet is healthy: the released slot serves new traffic
+        s2, _, body2 = post_chat(leader, {"prompt": "after"})
+        assert s2 == 201, body2
+
     def test_no_members_is_a_typed_503(self):
         with AppRunner(build=build_leader) as leader:
             status, _, body = post_chat(leader, {"prompt": "x"})

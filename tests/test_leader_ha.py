@@ -1,0 +1,316 @@
+"""Leader high availability: deterministic election via lease-with-
+epoch, worker-driven failover on missed acks, epoch fencing of revived
+stale leaders, stateless state rebuild on takeover, and the
+``GET /control/leader`` discovery contract — all over real HTTP."""
+
+import threading
+import time
+
+import pytest
+
+from gofr_tpu.serving.control_plane import (ControlPlaneLeader,
+                                            FleetConfig, NotLeader,
+                                            StaleLeader, WorkerAgent)
+from gofr_tpu.serving.faults import FaultPlan
+from gofr_tpu.service import probe_leader, resolve_leader
+
+from .apputil import AppRunner
+
+
+def make_leader(rank=0, candidates=(), **kw):
+    fleet = FleetConfig(leader_candidates=tuple(candidates))
+    leader = ControlPlaneLeader(coordinator="10.0.0.1:8476",
+                                rank=rank, fleet=fleet,
+                                host_id=f"leader-{rank}", **kw)
+
+    def build(app):
+        leader.install(app)
+    return leader, build
+
+
+def agent(port, host_id, **kw):
+    kw.setdefault("heartbeat_interval_s", 0.05)
+    return WorkerAgent(f"http://127.0.0.1:{port}", host_id=host_id,
+                       n_devices=2, **kw)
+
+
+def ha_agent(ports, host_id, **kw):
+    candidates = tuple(f"http://127.0.0.1:{p}" for p in ports)
+    kw.setdefault("fleet", FleetConfig(
+        leader_candidates=candidates, missed_acks_before_failover=1))
+    return agent(ports[0], host_id, **kw)
+
+
+# ------------------------------------------------------------ election
+class TestLeaseWithEpoch:
+    def test_rank0_boots_active_standby_boots_fenced(self):
+        active = ControlPlaneLeader(rank=0)
+        standby = ControlPlaneLeader(rank=1)
+        assert (active.active, active.epoch) == (True, 1)
+        assert (standby.active, standby.epoch) == (False, 0)
+        # a standby refuses non-takeover control writes, typed
+        with pytest.raises(NotLeader):
+            standby.join("w1", "127.0.0.1:1", 1)
+        with pytest.raises(NotLeader):
+            standby.heartbeat("w1", -1)
+
+    def test_takeover_activates_above_all_observed_epochs(self):
+        standby = ControlPlaneLeader(rank=1)
+        assert standby.ensure_active(worker_epoch=1)
+        assert standby.active and standby.epoch == 2
+        # a later takeover with stale evidence does not re-bump
+        assert not standby.ensure_active(worker_epoch=0)
+        assert standby.epoch == 2
+
+    def test_takeover_join_route_activates_and_rebuilds(self):
+        leader, build = make_leader(rank=1)
+        with AppRunner(build=build) as runner:
+            w = agent(runner.port, "w1")
+            w.epoch = 1           # learned from the dead leader
+            with pytest.raises(RuntimeError):
+                w.join()          # non-takeover: typed not_leader
+            w.join(takeover=True)
+            assert leader.active and leader.epoch == 2
+            assert w.epoch == 2   # worker adopts the new epoch
+            assert "w1" in leader.topology()["members"]
+            assert leader.leadership()["converging"] is False
+
+    def test_revived_stale_leader_is_fenced_then_reelected_higher(self):
+        leader, build = make_leader(rank=0)
+        with AppRunner(build=build) as runner:
+            w = agent(runner.port, "w1")
+            w.join()
+            # a newer leader was elected elsewhere: worker knows epoch 3
+            w.epoch = 3
+            w._heartbeat_once()   # 409 stale_leader -> fence -> walk
+            assert w.failovers.get("stale_leader") == 1
+            state = leader.leadership()
+            assert state["stale_rejects"] >= 1
+            # sole candidate: the write was REFUSED (fence), then the
+            # walk deterministically re-elected the leader strictly
+            # above every observed epoch — stale state can never win
+            assert state["active"] is True and state["epoch"] == 4
+            assert w.epoch == 4
+
+    def test_fence_raises_stale_leader_directly(self):
+        leader = ControlPlaneLeader(rank=0)
+        with pytest.raises(StaleLeader):
+            leader.heartbeat("w1", -1, epoch=9)
+        assert leader.active is False
+
+    def test_choose_candidate_is_a_pure_rank_epoch_decision(self):
+        choose = WorkerAgent._choose_candidate
+        a = {"rank": 0, "url": "a", "active": True, "epoch": 1}
+        b = {"rank": 1, "url": "b", "active": True, "epoch": 2}
+        s = {"rank": 2, "url": "c", "active": False, "epoch": 0}
+        # highest epoch wins among actives
+        assert choose([a, b, s], 1) == ("b", False)
+        # an active below the known epoch is a revived stale leader:
+        # never adopted as-is — the lowest-ranked live candidate is
+        # re-elected by takeover (which bumps past the known epoch)
+        assert choose([a, s], 2) == ("a", True)
+        # nothing reachable -> no decision
+        assert choose([], 0) is None
+        # ties break to the lowest rank, deterministically
+        b_same = dict(b, epoch=1)
+        assert choose([b_same, a], 1) == ("a", False)
+
+
+# ------------------------------------------------------------ failover
+class TestWorkerFailover:
+    def test_missed_acks_trigger_takeover_of_next_candidate(self):
+        leader0, build0 = make_leader(rank=0)
+        leader1, build1 = make_leader(rank=1)
+        with AppRunner(build=build0) as r0, \
+                AppRunner(build=build1) as r1:
+            w = ha_agent((r0.port, r1.port), "w1",
+                         summary_source=lambda: {
+                             "active_slots": 0, "waiting": 0,
+                             "prefix_hashes": [7, 8]})
+            w.join()
+            assert w.epoch == 1
+            # leader0 dies: every control RPC (probes too) -> 503
+            leader0.faults = FaultPlan.parse("leader_down:times=0")
+            w._heartbeat_once()   # miss -> walk -> takeover leader1
+            assert w.failovers.get("missed_acks") == 1
+            assert leader1.active and leader1.epoch == 2
+            assert w.epoch == 2
+            # stateless rebuild: the immediate post-join heartbeat
+            # already shipped the routing digest to the new leader
+            view = leader1.routing_view()
+            assert [m["host_id"] for m in view] == ["w1"]
+            assert leader1.leadership()["converging"] is False
+
+    def test_partitioned_host_alone_elects_the_standby(self):
+        leader0, build0 = make_leader(
+            rank=0, faults="leader_partition:request=w1,times=0")
+        leader1, build1 = make_leader(rank=1)
+        with AppRunner(build=build0) as r0, \
+                AppRunner(build=build1) as r1:
+            w2 = ha_agent((r0.port, r1.port), "w2")
+            w2.join()
+            w1 = ha_agent((r0.port, r1.port), "w1")
+            # w1 cannot even join leader0: the run-loop path walks the
+            # candidates; probes see leader0 active, but its join is
+            # refused -> strike it -> takeover-join the standby
+            assert w1._locate_leader()
+            assert leader1.active and "w1" in leader1.topology()["members"]
+            # the partition is asymmetric: w2 still heartbeats leader0
+            w2._heartbeat_once()
+            assert w2.failovers == {}
+
+    def test_stale_epoch_replay_is_rejected_and_rejoined(self):
+        leader, build = make_leader(rank=0)
+        with AppRunner(build=build) as runner:
+            w = agent(runner.port, "w1")
+            w.join()
+            leader.faults = FaultPlan.parse("stale_epoch_replay:at=1")
+            w._heartbeat_once()   # ack carries epoch-1: fenced
+            assert w.failovers.get("stale_leader") == 1
+            # the walk re-joined the (still healthy) leader and the
+            # follow-up heartbeat saw the true epoch again
+            assert w.epoch == leader.epoch == 1
+            assert "w1" in leader.topology()["members"]
+
+    def test_ack_drop_counts_as_a_missed_ack(self):
+        leader, build = make_leader(rank=0)
+        with AppRunner(build=build) as runner:
+            w = agent(runner.port, "w1",
+                      faults="ack_drop:at=1,times=2")
+            w.join()
+            w._heartbeat_once()
+            w._heartbeat_once()
+            # single-candidate fleet: misses accumulate, no walk
+            assert w._missed_acks == 2
+            assert w.failovers == {}
+
+    def test_single_candidate_worker_keeps_pre_ha_behavior(self):
+        leader, build = make_leader(rank=0)
+        with AppRunner(build=build) as runner:
+            w = agent(runner.port, "w1")
+            w.join()
+            assert w.candidates == (f"http://127.0.0.1:{runner.port}",)
+            assert w.missed_acks_before_failover == 3
+
+
+# ----------------------------------------------------------- discovery
+class TestDiscovery:
+    def test_control_leader_route_and_probe(self):
+        leader, build = make_leader(
+            rank=0, candidates=("http://a:1", "http://b:2"))
+        with AppRunner(build=build) as runner:
+            info = probe_leader(f"http://127.0.0.1:{runner.port}")
+            assert info["active"] is True
+            assert info["epoch"] == 1
+            assert info["rank"] == 0
+            assert info["candidates"] == ["http://a:1", "http://b:2"]
+            assert "heartbeat_interval_s" in info
+        # dead candidate: a None, never an exception
+        assert probe_leader(f"http://127.0.0.1:{runner.port}",
+                            timeout_s=0.2) is None
+
+    def test_resolve_leader_prefers_highest_epoch_active(self):
+        leader0, build0 = make_leader(rank=0)
+        leader1, build1 = make_leader(rank=1)
+        with AppRunner(build=build0) as r0, \
+                AppRunner(build=build1) as r1:
+            urls = (f"http://127.0.0.1:{r0.port}",
+                    f"http://127.0.0.1:{r1.port}")
+            got = resolve_leader(urls)
+            assert (got["rank"], got["epoch"]) == (0, 1)
+            # takeover elsewhere: the standby now out-ranks by epoch
+            leader1.ensure_active(worker_epoch=1)
+            got = resolve_leader(urls)
+            assert (got["rank"], got["epoch"]) == (1, 2)
+            # fencing rule: an active below epoch_at_least is skipped
+            got = resolve_leader(urls[:1], epoch_at_least=2)
+            assert got is None
+
+
+# ------------------------------------------------- leave during takeover
+class TestLeaveDuringTakeover:
+    def test_leave_retries_against_new_leader_and_sticks(self):
+        leader0, build0 = make_leader(rank=0)
+        leader1, build1 = make_leader(rank=1)
+        with AppRunner(build=build0) as r0, \
+                AppRunner(build=build1) as r1:
+            x = ha_agent((r0.port, r1.port), "x")
+            y = ha_agent((r0.port, r1.port), "y")
+            x.join()
+            y.join()
+            # leader0 dies; x starts deregistering INTO the takeover
+            # window while y drives the election
+            leader0.faults = FaultPlan.parse("leader_down:times=0")
+            done: list = []
+            t = threading.Thread(
+                target=lambda: done.append(x.deregister(rounds=40)))
+            t.start()
+            y._heartbeat_once()      # miss -> walk -> leader1 active
+            t.join(timeout=10)
+            assert done == [True]    # the leave landed post-election
+            hosts = leader1.topology()["members"]
+            assert "x" not in hosts and "y" in hosts
+            # a stale heartbeat can never re-adopt the departed host
+            x._heartbeat_once()
+            assert "x" not in leader1.topology()["members"]
+
+    def test_heartbeat_rejoin_is_suppressed_while_leaving(self):
+        leader, build = make_leader(rank=0)
+        with AppRunner(build=build) as runner:
+            w = agent(runner.port, "w1")
+            w.join()
+            assert w.deregister() is True
+            assert "w1" not in leader.topology()["members"]
+            # the leader answers this unknown host with rejoin; the
+            # leaving guard must ignore it
+            w._heartbeat_once()
+            assert "w1" not in leader.topology()["members"]
+
+
+# ------------------------------------------------------- data-plane gate
+class TestRouterGate:
+    def _post_chat(self, port):
+        import http.client
+        import json
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        try:
+            conn.request("POST", "/chat",
+                         body=json.dumps({"prompt": "hi"}),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, dict(resp.getheaders()), \
+                json.loads(resp.read().decode() or "{}")
+        finally:
+            conn.close()
+
+    def test_standby_router_serves_typed_not_leader(self):
+        from gofr_tpu.serving.router import RouterConfig
+        holder: dict = {}
+
+        def build(app):
+            holder["leader"] = app.serve_fleet_leader(
+                rank=1, router=RouterConfig(max_retries=0))
+        with AppRunner(build=build) as runner:
+            status, _, doc = self._post_chat(runner.port)
+            assert status == 503
+            details = doc["error"]["details"]
+            assert details["code"] == "not_leader"
+
+    def test_converging_takeover_serves_retryable_503(self):
+        from gofr_tpu.serving.router import RouterConfig
+        holder: dict = {}
+
+        def build(app):
+            holder["leader"] = app.serve_fleet_leader(
+                rank=1, router=RouterConfig(max_retries=0))
+        with AppRunner(build=build) as runner:
+            holder["leader"].ensure_active(worker_epoch=1)
+            status, headers, doc = self._post_chat(runner.port)
+            assert status == 503
+            details = doc["error"]["details"]
+            assert details["code"] == "leader_takeover"
+            lowered = {k.lower(): v for k, v in headers.items()}
+            assert int(lowered["retry-after"]) >= 1
+            # first member join ends the convergence window
+            holder["leader"].join("w1", "127.0.0.1:1", 1)
+            assert holder["leader"].leadership()["converging"] is False
