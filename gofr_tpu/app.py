@@ -295,6 +295,20 @@ class App:
             engine.slo = SLOTracker(slo or SLOConfig(),
                                     metrics=self.container.metrics,
                                     logger=self.logger)
+        # flight-data-recorder wiring: SLO trips land on the engine's
+        # event ledger, and a fast-burn trip snapshots an incident
+        # bundle (serving/events.py) — both no-ops when the ledger is
+        # disabled (GOFR_EVENTS=0 / EngineConfig.events=False)
+        slo_tracker = getattr(engine, "slo", None)
+        ev_ledger = getattr(engine, "events", None)
+        incidents = getattr(engine, "incidents", None)
+        if slo_tracker is not None and ev_ledger is not None \
+                and hasattr(slo_tracker, "events"):
+            slo_tracker.events = ev_ledger
+        if slo_tracker is not None and incidents is not None \
+                and getattr(slo_tracker, "on_fast_burn", True) is None:
+            slo_tracker.on_fast_burn = lambda: incidents.trigger(
+                "fast_burn", cause="SLO error-budget fast burn")
         # scheduler plumbing: the engine constructed its admission
         # queue already — swap in the app-level policy and wire the
         # shed-episode WARNs to the app logger
@@ -345,6 +359,7 @@ class App:
         routing tokenizer (default byte-level — correct whenever the
         workers serve byte-tokenized models)."""
         from .serving.control_plane import ControlPlaneLeader
+        kw.setdefault("metrics", self.container.metrics)
         leader = ControlPlaneLeader(coordinator=coordinator,
                                     host_id=host_id,
                                     logger=self.logger, **kw)
@@ -356,7 +371,8 @@ class App:
                 router = RouterConfig()
             fleet_router = FleetRouter(leader, router,
                                        tokenizer=tokenizer,
-                                       logger=self.logger)
+                                       logger=self.logger,
+                                       tracer=self.container.tracer)
             fleet_router.install(self)
             leader.router = fleet_router
         return leader
@@ -394,6 +410,11 @@ class App:
                        "summary_source": summary}
         kw.setdefault("metrics_source", self.container.metrics.snapshot)
         kw.setdefault("metrics", self.container.metrics)
+        # heartbeat event piggyback: the agent attaches the engine
+        # ledger's digest so the leader can merge a fleet timeline
+        if engine is not None \
+                and getattr(engine, "events", None) is not None:
+            kw.setdefault("events", engine.events)
         agent = WorkerAgent(leader_url, host_id=host_id,
                             address=addr_source,
                             tracer=self.container.tracer,
@@ -408,7 +429,9 @@ class App:
         ``serve_model``: ``GET /debug/engine`` (flight-recorder pass
         ring + request logs + stats for every served model),
         ``GET /debug/workload`` + ``POST /debug/workload/start|stop``
-        (workload capture download/arm/disarm) and, when
+        (workload capture download/arm/disarm), ``GET /debug/events``
+        (the flight-data-recorder event ring as gofr-events JSONL) +
+        ``GET /debug/incidents`` (snapshot bundles) and, when
         ``PROFILER_ENABLED`` is set, ``POST /debug/profile/start|stop``
         wrapping ``jax.profiler`` for on-demand xprof captures. All
         ride the normal middleware chain, so auth providers installed
@@ -577,6 +600,72 @@ class App:
             name, recorder = pick_workload_recorder(ctx)
             return {"model": name, "workload": recorder.stop()}
         self.post("/debug/workload/stop", workload_stop)
+
+        def pick_event_ledger(ctx):
+            """``?model=`` selects among served models (404 on an
+            unknown name or a disabled ledger); default is the first
+            served model."""
+            from .http.errors import ErrorEntityNotFound
+            name = ctx.param("model") or None
+            if not container.models:
+                raise ErrorEntityNotFound("model")
+            if name is None:
+                name = next(iter(container.models))
+            engine = container.models.get(name)
+            if engine is None:
+                raise ErrorEntityNotFound(f"model {name!r}")
+            ledger = getattr(engine, "events", None)
+            if ledger is None or not ledger.enabled:
+                raise ErrorEntityNotFound(
+                    f"model {name!r} has no event ledger "
+                    "(GOFR_EVENTS=0 or EngineConfig.events=False?)")
+            return name, ledger
+
+        def events_download(ctx):
+            """The event ring as versioned JSONL (``gofr-events`` v1)
+            — the flight data recorder's local timeline. ``?kind=``
+            filters, ``?since=`` (unix seconds) trims, ``?n=`` keeps
+            the newest n (clamped; garbage -> 400)."""
+            from .http.response import File
+            n = bounded_int_param(ctx, "n", default=0, lo=0, hi=1 << 20)
+            kind = ctx.param("kind") or None
+            raw_since = ctx.param("since")
+            since = None
+            if raw_since not in (None, ""):
+                try:
+                    since = float(raw_since)
+                except (TypeError, ValueError):
+                    from .http.errors import ErrorInvalidParam
+                    raise ErrorInvalidParam("since")
+            _, event_ledger = pick_event_ledger(ctx)
+            body = event_ledger.to_jsonl(kind=kind, since=since,
+                                         n=n or None)
+            return File(content=body.encode(),
+                        content_type="application/jsonl; charset=utf-8")
+        self.get("/debug/events", events_download)
+
+        def incidents_debug(ctx):
+            """Incident-bundle spool per served model; ``?id=``
+            fetches one full bundle (404 when unknown)."""
+            from .http.errors import ErrorEntityNotFound
+            incident_id = ctx.param("id") or None
+            out = {}
+            for model_name, engine in container.models.items():
+                detector = getattr(engine, "incidents", None)
+                if detector is None:
+                    out[model_name] = None
+                    continue
+                if incident_id is not None:
+                    bundle = detector.get(incident_id)
+                    if bundle is not None:
+                        return bundle
+                    continue
+                out[model_name] = {"incidents": detector.list(),
+                                   "detector": detector.state()}
+            if incident_id is not None:
+                raise ErrorEntityNotFound(f"incident {incident_id!r}")
+            return out
+        self.get("/debug/incidents", incidents_debug)
 
         enabled = self.config.get_bool("PROFILER_ENABLED", False) \
             if hasattr(self.config, "get_bool") else False

@@ -340,6 +340,15 @@ class Container:
                       "proxied streams cancelled because the "
                       "downstream client disconnected mid-stream "
                       "(upstream slot released early)")
+        # flight-data-recorder series (serving/events.py): written
+        # wherever a state transition lands on the event ledger —
+        # boundary/exception/control-plane code, never the hot loop
+        m.new_counter("app_events_total",
+                      "event-ledger records by kind "
+                      "(the flight data recorder's emission rate)")
+        m.new_counter("app_events_dropped",
+                      "event-ledger ring evictions by kind — a "
+                      "truncated timeline is visible, never silent")
 
     # ------------------------------------------------------------- health
     def health(self) -> dict[str, Any]:

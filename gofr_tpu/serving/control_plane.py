@@ -100,6 +100,8 @@ from ..http.errors import (ErrorInvalidParam, ErrorServiceUnavailable,
                            HTTPError)
 from ..logging.logger import WARN, set_fleet_context
 from ..metrics.registry import merge_snapshots, render_federated
+from ..tracing.tracer import current_span
+from .events import FleetEventMerger, IncidentDetector, resolve_ledger
 from .faults import NO_FAULTS, resolve_plan
 
 
@@ -279,7 +281,8 @@ class ControlPlaneLeader:
                  rank: int = 0,
                  metrics: Any = None,
                  logger: Any = None,
-                 faults: Any = None) -> None:
+                 faults: Any = None,
+                 events: Any = None) -> None:
         self.coordinator = coordinator
         self.heartbeat_interval_s = heartbeat_interval_s
         self.eviction_misses = eviction_misses
@@ -315,6 +318,26 @@ class ControlPlaneLeader:
         #: extra named () -> dict blocks merged into fleet_status()
         #: (``/debug/fleet``) — the router publishes its state here
         self.status_sources: dict[str, Any] = {}
+        #: the leader's own event ledger: failovers, fence rejects,
+        #: evictions, stragglers. app.serve_fleet_leader passes a
+        #: colocated engine's ledger in so one process shares one ring
+        self.events = resolve_ledger(events, host=host_id,
+                                     metrics=metrics)
+        #: per-host heartbeat event digests merged into the
+        #: skew-corrected fleet timeline (``GET /debug/fleet/events``);
+        #: evicted hosts' events are retained — the bundle for an
+        #: incident that killed a host must still show its last acts
+        self.merger = FleetEventMerger()
+        #: incident auto-snapshot riding the merged timeline — the
+        #: ``failover`` trigger fires here when a takeover commits
+        self.incidents = IncidentDetector(self.events.config,
+                                          ledger=self.events,
+                                          host=host_id, logger=logger)
+        self.incidents.timeline_source = self._incident_timeline
+        self.incidents.sources.update({
+            "leadership": self.leadership,
+            "fleet": self.fleet_status,
+        })
         if metrics is not None:
             self._register_metrics(metrics)
 
@@ -375,6 +398,21 @@ class ControlPlaneLeader:
         if self.logger:
             self.logger.warn("standby leader activated by takeover",
                              epoch=epoch, rank=self.rank)
+        # The takeover join arrives over HTTP, so the middleware has a
+        # server span open carrying the worker's trace — stamp its
+        # trace_id onto the failover record and the incident so the
+        # bundle resolves back to the exact request that elected us.
+        span = current_span()
+        trace_id = span.trace_id if span is not None else None
+        self.events.emit("fleet.epoch_bump", epoch=epoch,
+                         cause="takeover", trace_id=trace_id)
+        self.events.emit("fleet.failover", severity="error",
+                         cause="takeover", epoch=epoch, rank=self.rank,
+                         trace_id=trace_id)
+        self.incidents.trigger(
+            "failover", epoch=epoch, trace_id=trace_id,
+            cause=f"standby rank {self.rank} activated at epoch "
+                  f"{epoch} by worker takeover")
         return True
 
     def _fence(self, worker_epoch: int) -> None:
@@ -407,6 +445,9 @@ class ControlPlaneLeader:
                     "stale leader fenced: refusing control write and "
                     "demoting to standby", epoch=epoch,
                     caller_epoch=worker_epoch)
+            self.events.emit("fleet.fence_reject", severity="warn",
+                             cause="stale_leader", epoch=epoch,
+                             caller_epoch=worker_epoch)
             raise StaleLeader(
                 f"stale leader: caller epoch {worker_epoch} is ahead "
                 f"of this leader's epoch {epoch}",
@@ -464,7 +505,8 @@ class ControlPlaneLeader:
                   health: dict | None = None,
                   summary: dict | None = None,
                   metrics_snapshot: dict | None = None,
-                  address: str = "", epoch: int = -1
+                  address: str = "", epoch: int = -1,
+                  events: dict | None = None
                   ) -> tuple[ShardAssignment | None, bool]:
         """-> (assignment, changed): ``changed`` is True when the
         worker's view was stale — its signal to re-coordinate.
@@ -500,6 +542,11 @@ class ControlPlaneLeader:
             else:
                 assignment = self._assignment_locked(host_id)
                 changed = generation != self.generation
+        if events:
+            # the event-digest piggyback: fold this host's newest
+            # events (and its wall clock, for the skew estimate) into
+            # the fleet timeline
+            self.merger.ingest(host_id, events)
         if self.metrics is not None:
             self.metrics.increment_counter("app_fleet_heartbeats",
                                            host=host_id)
@@ -523,6 +570,9 @@ class ControlPlaneLeader:
             self.logger.warn("host evicted from serving group",
                              host=host_id, reason=reason,
                              generation=self.generation)
+        self.events.emit("fleet.evict", severity="warn", cause=reason,
+                         epoch=self.epoch, evicted=host_id,
+                         generation=self.generation)
         for listener in list(self.evict_listeners):
             try:
                 listener(host_id, reason)
@@ -656,8 +706,8 @@ class ControlPlaneLeader:
             if fleet_goodput.get("goodput_ratio") is not None:
                 self.metrics.set_gauge("app_fleet_goodput_ratio",
                                        fleet_goodput["goodput_ratio"])
-        if new and self.logger:
-            for host in sorted(new):
+        for host in sorted(new):
+            if self.logger:
                 self.logger.warn(
                     "straggler detected: pass duration skewed off the "
                     "fleet median", host=host,
@@ -665,6 +715,11 @@ class ControlPlaneLeader:
                     skew=round(pass_skew, 3), threshold=threshold,
                     # why is it slow? its own waste ledger answers
                     dominant_waste=straggler_causes.get(host))
+            self.events.emit(
+                "fleet.straggler", severity="warn", epoch=self.epoch,
+                cause=straggler_causes.get(host) or "unknown",
+                straggler=host, p95_s=p95s.get(host),
+                skew=round(pass_skew, 3))
         return {"pass_skew": round(pass_skew, 4),
                 "occupancy_skew": round(occ_skew, 4),
                 "straggler_ratio": round(ratio, 4),
@@ -726,6 +781,27 @@ class ControlPlaneLeader:
             except Exception:
                 out[name] = {"error": "status source failed"}
         return out
+
+    def _ingest_own_events(self) -> None:
+        """Fold the leader's own ledger into the merged timeline (its
+        clock IS the reference clock, so the offset is ~0)."""
+        self.merger.ingest(self.host_id or "leader",
+                           self.events.digest())
+
+    def _incident_timeline(self, since: float,
+                           until: float) -> list[dict]:
+        """IncidentDetector timeline source: the merged fleet view
+        around the trigger, corrected timestamps filtering."""
+        self._ingest_own_events()
+        return self.merger.timeline(since=since, until=until)
+
+    def fleet_events_jsonl(self, *, kind: str | None = None,
+                           since: float | None = None,
+                           n: int | None = None) -> str:
+        """The ``GET /debug/fleet/events`` body: versioned JSONL,
+        header line first, then the skew-corrected merged timeline."""
+        self._ingest_own_events()
+        return self.merger.to_jsonl(kind=kind, since=since, n=n)
 
     def fleet_metrics_text(self) -> str:
         """The federated Prometheus exposition for
@@ -839,7 +915,8 @@ class ControlPlaneLeader:
                 body.get("summary"),
                 body.get("metrics") if self.fleet.federation else None,
                 address=str(body.get("address", "")),
-                epoch=_body_int(body, "epoch", -1))
+                epoch=_body_int(body, "epoch", -1),
+                events=body.get("events"))
             epoch_out = self.epoch
             if self.faults is not NO_FAULTS \
                     and self.faults.trip("stale_epoch_replay"):
@@ -904,6 +981,39 @@ class ControlPlaneLeader:
         def debug_fleet(ctx):
             return self.fleet_status()
 
+        @app.get("/debug/fleet/events")
+        def debug_fleet_events(ctx):
+            # same query contract as GET /debug/events, served over
+            # the merged skew-corrected fleet timeline
+            from ..http.response import File
+            kind = ctx.param("kind") or None
+            raw_since = ctx.param("since")
+            since = None
+            if raw_since not in (None, ""):
+                try:
+                    since = float(raw_since)
+                except (TypeError, ValueError):
+                    raise ErrorInvalidParam("since")
+            n = _body_int({"n": ctx.param("n") or 0}, "n", 0)
+            n = max(0, min(1 << 20, n)) or None
+            body = self.fleet_events_jsonl(kind=kind, since=since, n=n)
+            return File(content=body.encode(),
+                        content_type="application/jsonl; charset=utf-8")
+
+        @app.get("/debug/fleet/incidents")
+        def debug_fleet_incidents(ctx):
+            # leader-side incident spool (failover bundles carry the
+            # MERGED fleet timeline); ?id= fetches one full bundle
+            incident_id = ctx.param("id") or None
+            if incident_id is None:
+                return {"incidents": self.incidents.list(),
+                        "detector": self.incidents.state()}
+            bundle = self.incidents.get(incident_id)
+            if bundle is None:
+                from ..http.errors import ErrorEntityNotFound
+                raise ErrorEntityNotFound(f"incident {incident_id!r}")
+            return bundle
+
         app.container.register_health_check("control_plane", self)
 
         @app.on_start
@@ -965,7 +1075,8 @@ class WorkerAgent:
                  tracer: Any = None,
                  logger: Any = None, service: Any = None,
                  faults: Any = None,
-                 metrics: Any = None) -> None:
+                 metrics: Any = None,
+                 events: Any = None) -> None:
         from ..service import CircuitBreaker, Retry, new_http_service
         self.host_id = host_id
         self.leader_url = leader_url
@@ -988,6 +1099,12 @@ class WorkerAgent:
         #: flight-recorder digest attached to every heartbeat (None =
         #: no summary); wire with engine_fleet_sources(engine)
         self.summary_source = summary_source
+        #: this host's EventLedger (the engine's, via App.join_fleet):
+        #: worker-side failover/fence decisions are recorded on it and
+        #: its digest piggybacks on every heartbeat so the leader can
+        #: merge the fleet timeline
+        from .events import NO_EVENTS as _no_events
+        self.events = events if events is not None else _no_events
         #: Manager.snapshot() attached when FleetConfig.federation
         self.metrics_source = metrics_source
         self.fleet = fleet if fleet is not None else FleetConfig()
@@ -1149,7 +1266,13 @@ class WorkerAgent:
             return True  # pre-HA leader: no epochs on the wire
         epoch = int(raw)
         if epoch < self.epoch:
+            self.events.emit("fleet.fence_reject", severity="warn",
+                             cause="stale_ack", epoch=self.epoch,
+                             ack_epoch=epoch)
             return False
+        if epoch > self.epoch:
+            self.events.emit("fleet.epoch_bump", epoch=epoch,
+                             cause="ack_adopted")
         self.epoch = epoch
         return True
 
@@ -1174,6 +1297,8 @@ class WorkerAgent:
         if self.logger:
             self.logger.warn("leader failover triggered", reason=reason,
                              host=self.host_id, epoch=self.epoch)
+        self.events.emit("fleet.failover", severity="warn",
+                         cause=reason, epoch=self.epoch)
         return self._locate_leader()
 
     def _probe_candidates(self) -> list[dict]:
@@ -1295,6 +1420,11 @@ class WorkerAgent:
                 body["summary"] = self.summary_source()
             except Exception:
                 pass  # a broken digest must not kill the heartbeat
+        if self.events.enabled:
+            try:
+                body["events"] = self.events.digest()
+            except Exception:
+                pass  # same contract as the summary digest
         if self.fleet.federation and self.metrics_source is not None:
             try:
                 snap = self.metrics_source()

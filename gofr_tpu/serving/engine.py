@@ -360,6 +360,13 @@ class EngineConfig:
     #: comparison against NO_FAULTS, so the disabled default costs
     #: nothing and transfer-guard/bit-identity invariants hold.
     faults: Any = None
+    #: the fleet flight data recorder (serving/events.py): an
+    #: EventLedgerConfig, an EventLedger, True/False, or None = default
+    #: ledger unless the ``GOFR_EVENTS`` env disables it. Emission only
+    #: happens at already-declared @hot_path_boundary sites, so the
+    #: zero-hot-path invariant holds with the ledger ON; False wires
+    #: the NO_EVENTS no-op singleton everywhere.
+    events: Any = None
     #: crash recovery: a RestartPolicy arms the in-thread supervisor —
     #: a hot-loop exception salvages pre-first-token requests into the
     #: recovery buffer, fails mid-stream ones with a typed retryable
@@ -686,6 +693,27 @@ class Engine:
         #: NO_FAULTS singleton, so every site guards with one identity
         #: comparison (``self.faults is not NO_FAULTS``)
         self.faults = resolve_plan(config.faults)
+        # fleet flight data recorder: the causal event ledger every
+        # state transition is recorded on, plus the incident detector
+        # that snapshots a diagnostic bundle when the fleet does
+        # something an operator will be asked about (serving/events.py)
+        from .events import IncidentDetector, resolve_ledger
+        self.events = resolve_ledger(config.events, metrics=metrics)
+        if self.faults is not NO_FAULTS:
+            self.faults.events = self.events
+        self.watermarks.events = self.events
+        self.incidents = IncidentDetector(self.events.config,
+                                          ledger=self.events,
+                                          logger=logger)
+        self.incidents.sources.update({
+            "slo": lambda: (self.slo.state()
+                            if self.slo is not None else None),
+            "scheduler": lambda: self.waiting.state(),
+            "goodput": self.goodput.state,
+            "watermarks": self.watermarks.state,
+            "recorder": self.recorder.snapshot,
+            "config": self.config_digest,
+        })
         # crash-recovery supervisor state (see _recover / RestartPolicy)
         self._restarts = 0
         self._last_crash: str | None = None
@@ -705,6 +733,7 @@ class Engine:
                                  ledger=self.usage_ledger,
                                  slo_source=lambda: self.slo,
                                  metrics=metrics, logger=logger)
+        self.waiting.events = self.events
 
         if self.metrics is not None:
             self.attach_metrics(self.metrics)
@@ -884,6 +913,10 @@ class Engine:
                         f"engine thread still in a device call; "
                         f"{stranded_active} active slot(s) stranded — "
                         "streams retire when the pass completes")
+                self.events.emit("engine.stranded_slot",
+                                 severity="warn",
+                                 cause="stop timed out mid device call",
+                                 slots=stranded_active)
                 self.waiting.close()
                 stranded = self.waiting.pop_batch(1 << 16, first_wait_s=0.0)
                 for req in stranded or []:
@@ -902,6 +935,8 @@ class Engine:
         like a plain stop). The engine can ``start()`` again after."""
         deadline = time.time() + timeout_s
         self._draining = True
+        self.events.emit("engine.drain", cause="admission closed",
+                         timeout_s=timeout_s)
         try:
             drained = False
             while True:
@@ -1133,6 +1168,11 @@ class Engine:
              "scheduler-initiated background preemptions to unstarve "
              "the interactive lane (priced by the preempt_recompute "
              "goodput ledger)"),
+            ("app_events_total",
+             "event-ledger records by kind (serving/events.py)"),
+            ("app_events_dropped",
+             "event-ledger ring evictions by kind — a truncated "
+             "timeline is visible, never silent"),
         ):
             if metrics.get(name) is None:
                 metrics.new_counter(name, desc)
@@ -1173,6 +1213,22 @@ class Engine:
         if getattr(self.waiting, "metrics", None) is None \
                 and hasattr(self.waiting, "publish_gauges"):
             self.waiting.metrics = metrics
+        if self.events.enabled and self.events.metrics is None:
+            self.events.metrics = metrics
+
+    def config_digest(self) -> dict:
+        """JSON-safe engine-config summary for incident bundles: plain
+        scalars pass through, everything else stringifies (a bundle
+        must always serialize)."""
+        from dataclasses import fields as _fields
+        out = {}
+        for f in _fields(self.config):
+            value = getattr(self.config, f.name)
+            out[f.name] = value if isinstance(
+                value, (bool, int, float, str, type(None))) \
+                else repr(value)
+        out["resolved_seed"] = self.seed
+        return out
 
     def warmup(self, prompt_lens: tuple = (1,), decode: bool = True,
                chunked: bool = False) -> None:
@@ -2102,6 +2158,9 @@ class Engine:
                 "unexpected post-warmup recompile: dispatch shape was "
                 "never compiled during warmup",
                 signature="/".join(str(p) for p in sig))
+        self.events.emit(
+            "obs.recompile", severity="warn",
+            signature="/".join(str(p) for p in sig))
 
     def _note_device_idle(self) -> None:
         """Goodput bubble tracking: a synchronous collect finished and
@@ -3364,8 +3423,17 @@ class Engine:
         with a typed retryable ``engine_restart`` reject (503 +
         Retry-After + details.code through the handlers)."""
         policy = self.config.restart_policy
-        if (policy is None or not self._running
-                or self._restarts >= policy.max_restarts):
+        if policy is None or not self._running:
+            return False
+        if self._restarts >= policy.max_restarts:
+            # budget exhausted: this crash is terminal — snapshot an
+            # incident bundle before _crash tears down (the bundle's
+            # timeline seals with the engine.crash event _crash emits)
+            self.incidents.trigger(
+                "restart_budget",
+                cause=f"{self._restarts} restarts >= budget "
+                      f"{policy.max_restarts}; last crash: "
+                      f"{type(exc).__name__}: {exc}")
             return False
         self._restarts += 1
         self._last_crash = f"{type(exc).__name__}: {exc}"
@@ -3378,6 +3446,11 @@ class Engine:
             self.recorder.dump(self.logger, reason=self._last_crash)
         if self.metrics is not None:
             self.metrics.increment_counter("app_engine_restarts")
+        self.events.emit("engine.restart", severity="error",
+                         cause=self._last_crash,
+                         restart=self._restarts,
+                         max_restarts=policy.max_restarts,
+                         backoff_s=round(backoff, 3))
         from .scheduler import SchedReject
         recovered = 0
         for i, req in enumerate(self.active):
@@ -3424,6 +3497,9 @@ class Engine:
             # the loop, which then exits through the CLEAN path
             time.sleep(min(0.05, max(0.0, deadline - time.time())))
         self._last_beat = time.time()
+        self.events.emit("engine.recovery",
+                         cause=self._last_crash,
+                         restart=self._restarts, recovered=recovered)
         return True
 
     def _crash(self, exc: BaseException) -> None:
@@ -3436,6 +3512,8 @@ class Engine:
         fast and loudly rather than hanging every stream forever."""
         self._failed = f"{type(exc).__name__}: {exc}"
         self._running = False
+        self.events.emit("engine.crash", severity="error",
+                         cause=self._failed, restarts=self._restarts)
         if self.logger:
             self.logger.error(f"engine loop crashed: {exc!r}")
             # post-mortem: the last N pass records tell you what the

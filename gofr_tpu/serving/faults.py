@@ -72,6 +72,7 @@ import time
 from dataclasses import dataclass, field
 
 from ..analysis.annotations import hot_path_boundary
+from .events import NO_EVENTS
 
 SITES = frozenset({
     "pass_raise", "pass_stall", "pass_latency", "page_exhaustion",
@@ -124,6 +125,9 @@ class FaultPlan:
             self._by_site.setdefault(spec.site, []).append(spec)
         # observability for tests and /debug surfaces
         self.fired: dict[str, int] = {}
+        #: EventLedger fault firings are recorded on; the engine wires
+        #: its ledger onto armed plans only (NO_FAULTS stays pristine)
+        self.events = NO_EVENTS
 
     # ------------------------------------------------------------ state
     @property
@@ -166,6 +170,10 @@ class FaultPlan:
             self.fired[site] = self.fired.get(site, 0) + 1
             if site in _SLEEP_SITES and spec.seconds > 0.0:
                 time.sleep(spec.seconds)
+        if fired:
+            self.events.emit("fault.trip", severity="warn",
+                             request_id=request_id, cause=site,
+                             fired=self.fired[site])
         if fired and site in _RAISE_SITES:
             raise InjectedFault(f"injected fault: {site}")
         return fired

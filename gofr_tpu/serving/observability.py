@@ -26,6 +26,8 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Any
 
+from .events import NO_EVENTS
+
 
 class FlightRecorder:
     """Fixed-size ring of per-pass records plus a short log of retired
@@ -347,6 +349,12 @@ class WatermarkTracker:
     def __init__(self, enabled: bool = True) -> None:
         self.enabled = bool(enabled)
         self._marks: dict[str, dict] = {}
+        #: EventLedger high-water crossings are recorded on (engine
+        #: wiring); crossings within 5% of the last recorded one are
+        #: not re-recorded, so a slowly creeping mark can't flood the
+        #: ring while the ratchet still lands in the timeline
+        self.events = NO_EVENTS
+        self._event_marks: dict[str, float] = {}
 
     def update(self, name: str, value: float,
                t: float | None = None) -> bool:
@@ -359,6 +367,10 @@ class WatermarkTracker:
             return False
         self._marks[name] = {"value": value,
                              "t": time.time() if t is None else t}
+        last = self._event_marks.get(name)
+        if last is None or value >= last * 1.05:
+            self._event_marks[name] = value
+            self.events.emit("obs.watermark", cause=name, value=value)
         return True
 
     def update_rss(self) -> None:
@@ -852,6 +864,11 @@ class SLOTracker:
         self.config = config if config is not None else SLOConfig()
         self.metrics = metrics
         self.logger = logger
+        #: EventLedger fast-burn episodes are recorded on (app wiring)
+        self.events = NO_EVENTS
+        #: optional zero-arg hook fired once per fast-burn episode —
+        #: the IncidentDetector's trigger rides here
+        self.on_fast_burn = None
         self._lock = threading.Lock()
         horizons = tuple(sorted(set(
             tuple(self.config.windows) + (self.config.budget_window_s,))))
@@ -976,6 +993,17 @@ class SLOTracker:
                     f"{state['fast_burn']['window']} window",
                     threshold=state["fast_burn"]["threshold"],
                     budget_remaining=state["budget"]["remaining"])
+            self.events.emit(
+                "obs.fast_burn", severity="error",
+                burn_rate=state["fast_burn"]["burn_rate"],
+                window=state["fast_burn"]["window"],
+                budget_remaining=state["budget"]["remaining"])
+            hook = self.on_fast_burn
+            if hook is not None:
+                try:
+                    hook()
+                except Exception:
+                    pass  # an incident capture must never fail a retire
         elif not tripped:
             self._escalated = False  # episode over; re-arm
 
@@ -1060,6 +1088,12 @@ class StallWatchdog:
                 "stalled_for_s": stalled_for,
                 "active_slots": health.get("active_slots"),
                 "waiting": health.get("waiting")}).end()
+        getattr(engine, "events", NO_EVENTS).emit(
+            "fleet.stall", severity="error",
+            cause="no pass completed",
+            stalled_for_s=stalled_for,
+            active_slots=health.get("active_slots"),
+            waiting=health.get("waiting"))
         return True
 
 

@@ -146,9 +146,19 @@ def efficiency_divergence(recorded: dict | None,
 
 
 # -------------------------------------------------------------- replay
+def load_events(path: str) -> dict:
+    """Load a ``GET /debug/events`` capture (gofr-events JSONL) for
+    the replay event-timeline diff: ``{"header", "events"}``."""
+    from .events import parse_events
+    with open(path) as f:
+        header, events = parse_events(f.read())
+    return {"header": header, "events": events}
+
+
 def replay_workload(engine: Any, workload: dict, *, speed: float = 1.0,
                     closed_loop: int = 0,
-                    timeout_s: float = 300.0) -> dict:
+                    timeout_s: float = 300.0,
+                    events: dict | None = None) -> dict:
     """Re-inject a parsed workload through ``engine`` and return the
     divergence + latency report. The engine is started if it is not
     running (and left running — the caller owns its lifecycle).
@@ -156,6 +166,13 @@ def replay_workload(engine: Any, workload: dict, *, speed: float = 1.0,
     ``speed`` scales the recorded inter-arrival gaps (2.0 = twice as
     fast); ``closed_loop=N`` ignores timing entirely and keeps N
     requests in flight — the stress mode for saturation testing.
+
+    ``events`` is an optional :func:`load_events` capture recorded
+    alongside the workload (``GET /debug/events``); when given, the
+    report gains an ``event_divergence`` block comparing the capture's
+    event timeline against the events this replay emitted — a replay
+    that matches every token but restarts twice or sheds load is a
+    behavioral divergence the token diff cannot see.
     """
     header = workload.get("header") or {}
     records = workload.get("records") or []
@@ -173,6 +190,11 @@ def replay_workload(engine: Any, workload: dict, *, speed: float = 1.0,
         # a clean meter for this replay: the report compares the
         # replay's OWN waste breakdown against the capture's
         goodput.reset()
+    # seq watermark: only events emitted DURING this replay count
+    # toward the event-timeline diff
+    ledger = getattr(engine, "events", None)
+    events_seq0 = ledger.state()["seq"] \
+        if ledger is not None and getattr(ledger, "enabled", False) else 0
     if not getattr(engine, "_running", False):
         engine.start()
 
@@ -260,6 +282,15 @@ def replay_workload(engine: Any, workload: dict, *, speed: float = 1.0,
     recorded_goodput = header.get("goodput")
     replayed_goodput = goodput.summary() if goodput is not None \
         and getattr(goodput, "enabled", False) else None
+    event_divergence = None
+    if events is not None:
+        from .events import event_timeline_diff
+        replayed_events = [
+            e for e in (ledger.snapshot() if ledger is not None
+                        and getattr(ledger, "enabled", False) else [])
+            if e.get("seq", 0) > events_seq0]
+        event_divergence = event_timeline_diff(
+            events.get("events") or [], replayed_events)
     return {
         "requests": len(records),
         "submitted": len(pairs),
@@ -280,6 +311,9 @@ def replay_workload(engine: Any, workload: dict, *, speed: float = 1.0,
         "replayed_goodput": replayed_goodput,
         "efficiency_divergence": efficiency_divergence(
             recorded_goodput, replayed_goodput),
+        # behavioral twin: the flight recorder's event timeline
+        # (restarts, sheds, preemptions) compared kind-for-kind
+        "event_divergence": event_divergence,
         "slo": slo.state() if slo is not None else None,
     }
 
@@ -289,6 +323,6 @@ def replay_file(engine: Any, path: str, **kw) -> dict:
     return replay_workload(engine, load_workload(path), **kw)
 
 
-__all__ = ["parse_workload", "load_workload", "replay_workload",
-           "replay_file", "efficiency_divergence",
+__all__ = ["parse_workload", "load_workload", "load_events",
+           "replay_workload", "replay_file", "efficiency_divergence",
            "MAX_DIVERGENCES_REPORTED"]

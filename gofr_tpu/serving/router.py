@@ -60,6 +60,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from ..http.responder import ResponseData
+from .events import NO_EVENTS
 
 #: response headers mirrored back to the client on proxied replies
 _MIRROR_HEADERS = ("retry-after",)
@@ -224,6 +225,10 @@ class Autoscaler:
         self.logger = logger
         self.on_decision = on_decision
         self.setpoint = int(config.setpoint_concurrency)
+        #: EventLedger scale decisions land on; FleetRouter wires the
+        #: leader's ledger here so decisions show up in the fleet
+        #: timeline next to the evictions they cause
+        self.events = NO_EVENTS
         self.decisions: deque = deque(maxlen=max(1, config.decisions_kept))
         self._pressure_since: float | None = None
         self._idle_since: float | None = None
@@ -303,6 +308,10 @@ class Autoscaler:
         if self.metrics is not None:
             self.metrics.increment_counter("app_router_scale_decisions",
                                            action=action)
+        self.events.emit(
+            "router.scale", severity="warn", cause=action,
+            **{k: v for k, v in decision.items()
+               if k not in ("action", "at")})
         if self.logger:
             self.logger.warn("autoscale decision", **decision)
         if self.on_decision is not None:
@@ -324,7 +333,7 @@ class FleetRouter:
 
     def __init__(self, leader: Any, config: RouterConfig | None = None,
                  *, tokenizer: Any = None, metrics: Any = None,
-                 logger: Any = None,
+                 logger: Any = None, tracer: Any = None,
                  clock: Callable[[], float] = time.monotonic) -> None:
         if tokenizer is None:
             from .tokenizer import ByteTokenizer
@@ -334,6 +343,12 @@ class FleetRouter:
         self.tokenizer = tokenizer
         self.metrics = metrics
         self.logger = logger
+        self.tracer = tracer
+        # router events land on the leader's ledger (the router IS the
+        # leader's data plane) so they interleave with evict/failover
+        # in one timeline; a ledger-less leader (tests) gets NO_EVENTS
+        events = getattr(leader, "events", None)
+        self.events = events if events is not None else NO_EVENTS
         self.clock = clock
         self.affinity = SessionAffinity(self.config.affinity_size)
         self.autoscaler: Autoscaler | None = None
@@ -342,6 +357,7 @@ class FleetRouter:
                 self.config, clock=clock, metrics=metrics, logger=logger,
                 on_decision=self._act_on_decision
                 if self.config.autoscale_act else None)
+            self.autoscaler.events = self.events
         #: routed accounting, all under _lock: per-host counts feed the
         #: share gauge and /debug/fleet; hits feed the cache-hit ratio
         self._lock = threading.Lock()
@@ -359,7 +375,11 @@ class FleetRouter:
     # ------------------------------------------------------- membership
     def _on_member_gone(self, host_id: str, reason: str) -> None:
         dropped = self.affinity.drop_host(host_id)
-        if dropped and self.logger:
+        if not dropped:
+            return
+        self.events.emit("router.affinity_drop", severity="warn",
+                         cause=reason, host=host_id, sessions=dropped)
+        if self.logger:
             self.logger.info(
                 "router dropped session affinity for departed host",
                 host=host_id, reason=reason, sessions=dropped)
@@ -588,8 +608,43 @@ class FleetRouter:
             raise
 
     async def proxy_request(self, ctx, path: str) -> ResponseData:
-        self._leadership_gate()
         request = ctx.request
+        # the router's half of the trace graph: a router.route span
+        # joins the client's traceparent (or the server middleware's
+        # span via the contextvar) and is injected downstream so the
+        # worker's engine spans hang off it; retries/failovers become
+        # post-hoc child spans, and every router event carries the
+        # trace_id so timeline entries resolve back to the trace
+        span = None
+        if self.tracer is not None:
+            span = self.tracer.start_span(
+                "router.route",
+                traceparent=request.header("traceparent"),
+                attributes={"path": path})
+        try:
+            return await self._proxy_request(ctx, request, path, span)
+        except Exception as exc:
+            if span is not None:
+                span.set_status(f"ERROR: {exc}")
+            raise
+        finally:
+            if span is not None:
+                span.end()
+
+    def _failover_span(self, span, name: str, started: float,
+                       host: str, code: str) -> None:
+        if span is None:
+            return
+        self.tracer.emit_span(
+            name, trace_id=span.trace_id, parent_id=span.span_id,
+            start_time=started, end_time=time.time(),
+            attributes={"host": host, "code": code},
+            status=f"ERROR: {code}")
+
+    async def _proxy_request(self, ctx, request, path: str,
+                             span) -> ResponseData:
+        self._leadership_gate()
+        trace_id = span.trace_id if span is not None else None
         raw_body = getattr(request, "body", b"") or b""
         try:
             body = json.loads(raw_body) if raw_body else {}
@@ -612,11 +667,16 @@ class FleetRouter:
                 headers={"Retry-After": "1"})
         headers = {k: request.header(k) for k in _FORWARD_HEADERS
                    if request.header(k)}
+        if self.tracer is not None:
+            # replace the client's traceparent with the router span so
+            # the worker's server span is a child of router.route
+            self.tracer.inject_headers(headers)
         attempts = min(len(plan), self.config.max_retries + 1)
         last: ResponseData | None = None
         retry_code = ""
         for attempt in range(attempts):
             cand = plan[attempt]
+            started = time.time()
             if attempt:
                 self._note_retry(retry_code)
             try:
@@ -627,6 +687,13 @@ class FleetRouter:
             except (OSError, asyncio.TimeoutError,
                     asyncio.IncompleteReadError) as exc:
                 retry_code = "connect_error"
+                # transport-level failover: the host is gone, not busy
+                self.events.emit(
+                    "router.failover", severity="warn",
+                    cause=retry_code, trace_id=trace_id,
+                    host=cand["host_id"], attempt=attempt)
+                self._failover_span(span, "router.failover", started,
+                                    cand["host_id"], retry_code)
                 last = _error_response(
                     502, f"upstream {cand['host_id']} unreachable: "
                          f"{exc!r}")
@@ -643,9 +710,19 @@ class FleetRouter:
                         code in self.config.retryable_codes
                         or "retry-after" in uhdrs):
                     retry_code = code or "503"
+                    # typed retry: the host said "not right now"
+                    self.events.emit(
+                        "router.retry", severity="warn",
+                        cause=retry_code, trace_id=trace_id,
+                        host=cand["host_id"], attempt=attempt)
+                    self._failover_span(span, "router.retry", started,
+                                        cand["host_id"], retry_code)
                     continue
                 return last
             self._note_routed(cand, session, retried=attempt)
+            if span is not None:
+                span.attributes["host"] = cand["host_id"]
+                span.attributes["attempts"] = attempt + 1
             ctype = uhdrs.get("content-type",
                               "application/octet-stream")
             if uhdrs.get("transfer-encoding", "").lower() == "chunked" \
@@ -717,6 +794,8 @@ class FleetRouter:
             self.metrics = app.container.metrics
             if self.autoscaler is not None:
                 self.autoscaler.metrics = self.metrics
+        if self.tracer is None:
+            self.tracer = getattr(app.container, "tracer", None)
         for name, desc in _ROUTER_GAUGES:
             if self.metrics.get(name) is None:
                 self.metrics.new_gauge(name, desc)

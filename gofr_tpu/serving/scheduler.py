@@ -50,6 +50,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 from ..analysis import hot_path_boundary
+from .events import NO_EVENTS
 
 INTERACTIVE = "interactive"
 BACKGROUND = "background"
@@ -224,6 +225,10 @@ class Scheduler:
         self.slo_source = slo_source
         self.metrics = metrics
         self.logger = logger
+        #: EventLedger admission decisions are recorded on; replaced by
+        #: ``app.serve_model`` with the engine's ledger (NO_EVENTS is a
+        #: no-op sink, so standalone schedulers stay silent, not broken)
+        self.events = NO_EVENTS
         self._lock = threading.Condition()
         self._tenants: dict[str, _TenantState] = {}
         self._size = 0
@@ -412,9 +417,14 @@ class Scheduler:
                     "shedding background and over-share traffic until "
                     "the burn recovers",
                     burn_rate=round(self._slo_burn, 2))
+            self.events.emit("sched.shed_open", severity="warn",
+                             cause="fast_burn",
+                             burn_rate=round(self._slo_burn, 2))
         elif not self._slo_tripped and self._shed_active:
             self._shed_active = False
             self._shed_since = None
+            self.events.emit("sched.shed_close",
+                             burn_rate=round(self._slo_burn, 2))
 
     def _shed_verdict_locked(self, req: Any, lane: str,
                              now: float) -> bool:
@@ -445,6 +455,10 @@ class Scheduler:
         if self.metrics is not None:
             self.metrics.increment_counter("app_sched_rejections",
                                            cause=code, tenant=tenant)
+        self.events.emit("sched.reject", severity="warn",
+                         request_id=getattr(req, "request_id", None),
+                         tenant=tenant, cause=code,
+                         retry_after_s=round(retry_after_s, 3))
         return False
 
     @hot_path_boundary(
@@ -620,6 +634,8 @@ class Scheduler:
             self.counters["preemptions"] += 1
         if self.metrics is not None:
             self.metrics.increment_counter("app_sched_preemptions")
+        self.events.emit("sched.preempt", severity="warn",
+                         cause="starvation")
 
     # ----------------------------------------------------------- retire
     @hot_path_boundary(

@@ -4,11 +4,12 @@ from .tracer import (
     ConsoleExporter,
     InMemoryExporter,
     Tracer,
+    current_span,
     extract_traceparent,
     format_traceparent,
 )
 
 __all__ = [
     "Span", "SpanExporter", "ConsoleExporter", "InMemoryExporter", "Tracer",
-    "extract_traceparent", "format_traceparent",
+    "current_span", "extract_traceparent", "format_traceparent",
 ]

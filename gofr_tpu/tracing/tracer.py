@@ -42,6 +42,14 @@ def _rand_hex(nbytes: int) -> str:
 _sample_rng = random.Random(os.urandom(8))
 
 
+def current_span() -> "Span | None":
+    """The span active on this thread/task context, if any — the
+    module-level accessor for code (control plane, event ledger
+    emitters) that has no Tracer instance in hand but wants to stamp
+    records with the ambient trace id."""
+    return _current_span.get()
+
+
 def extract_traceparent(header: str | None) -> tuple[str, str] | None:
     """Parse ``00-<trace-id>-<parent-id>-<flags>`` -> (trace_id, parent_id)."""
     if not header:

@@ -1,0 +1,111 @@
+"""One-command incident bundle from a live gofr-tpu host.
+
+Usage:
+    python scripts/bundle.py http://host:8000 [--out BUNDLE.json]
+                             [--incident ID] [--timeout S]
+
+Fetches the flight-data-recorder surfaces — the event ledger
+(``/debug/events``), spooled incident bundles (``/debug/incidents``),
+flight recorder + stats (``/debug/engine``), goodput
+(``/debug/efficiency``), SLO (``/debug/slo``), scheduler
+(``/debug/scheduler``), the workload capture (``/debug/workload``) and,
+when the host is a fleet leader, the merged fleet timeline
+(``/debug/fleet/events``) + leader incidents + ``/debug/fleet`` — into
+ONE JSON document you can attach to a ticket and replay later:
+
+    python scripts/replay.py <(jq -r .workload bundle.json) \
+        --events <(jq -r .events bundle.json)
+
+``--incident ID`` additionally inlines that spooled bundle verbatim.
+Surfaces a host does not serve are recorded as ``null`` with the error
+string under ``errors`` — a partial bundle from a sick host is the
+whole point, so nothing here is fatal except total unreachability.
+"""
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+#: (bundle key, path, is_json) — text surfaces (JSONL) keep raw bytes
+SURFACES = (
+    ("events", "/debug/events", False),
+    ("incidents", "/debug/incidents", True),
+    ("engine", "/debug/engine", True),
+    ("efficiency", "/debug/efficiency", True),
+    ("slo", "/debug/slo", True),
+    ("scheduler", "/debug/scheduler", True),
+    ("workload", "/debug/workload", False),
+    ("fleet", "/debug/fleet", True),
+    ("fleet_events", "/debug/fleet/events", False),
+    ("fleet_incidents", "/debug/fleet/incidents", True),
+    ("health", "/.well-known/alive", True),
+)
+
+
+def fetch(base: str, path: str, timeout: float) -> bytes:
+    req = urllib.request.Request(base + path,
+                                 headers={"Accept": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.read()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("base", help="host base URL, e.g. http://host:8000")
+    ap.add_argument("--out", default="bundle.json",
+                    help="output path (default bundle.json)")
+    ap.add_argument("--incident", default=None, metavar="ID",
+                    help="also inline this spooled incident bundle")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args()
+    base = args.base.rstrip("/")
+    if "://" not in base:  # bare HOST:PORT is the 3am spelling
+        base = "http://" + base
+
+    bundle: dict = {"format": "gofr-bundle", "version": 1, "base": base}
+    errors: dict = {}
+    reached = 0
+    for key, path, is_json in SURFACES:
+        try:
+            raw = fetch(base, path, args.timeout)
+            reached += 1
+        except (urllib.error.URLError, OSError, ValueError) as exc:
+            bundle[key] = None
+            errors[key] = str(exc)
+            continue
+        if is_json:
+            try:
+                bundle[key] = json.loads(raw)
+            except ValueError:
+                bundle[key] = raw.decode(errors="replace")
+        else:
+            bundle[key] = raw.decode(errors="replace")
+    if args.incident:
+        for path in (f"/debug/incidents?id={args.incident}",
+                     f"/debug/fleet/incidents?id={args.incident}"):
+            try:
+                bundle["incident"] = json.loads(
+                    fetch(base, path, args.timeout))
+                break
+            except (urllib.error.URLError, OSError, ValueError) as exc:
+                bundle["incident"] = None
+                errors["incident"] = str(exc)
+    if errors:
+        bundle["errors"] = errors
+    if not reached:
+        print(f"# UNREACHABLE: no debug surface answered at {base}",
+              file=sys.stderr)
+        return 2
+    with open(args.out, "w") as f:
+        json.dump(bundle, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"# bundle: {args.out} ({reached}/{len(SURFACES)} surfaces"
+          f"{', ' + str(len(errors)) + ' errors' if errors else ''})",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,11 +3,13 @@
 Usage:
     python scripts/replay.py WORKLOAD.jsonl [--speed N] [--closed-loop C]
                              [--seed S] [--max-batch B] [--max-seq L]
+                             [--events EVENTS.jsonl]
                              [--report OUT.json] [--no-fail]
 
 Downloads from a live server land here:
     curl -s http://host:8000/debug/workload > incident.jsonl
-    python scripts/replay.py incident.jsonl
+    curl -s http://host:8000/debug/events   > incident-events.jsonl
+    python scripts/replay.py incident.jsonl --events incident-events.jsonl
 
 Builds the demo tiny-llama engine (the same model family the CPU
 smokes and tests use) with the workload header's ``engine_seed``
@@ -44,6 +46,10 @@ def main() -> int:
     ap.add_argument("--max-batch", type=int, default=4)
     ap.add_argument("--max-seq", type=int, default=256)
     ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--events", default=None, metavar="EVENTS.jsonl",
+                    help="event-ledger capture recorded alongside the "
+                         "workload (GET /debug/events); the report "
+                         "gains an event-timeline diff")
     ap.add_argument("--report", default=None,
                     help="also write the report JSON to this path")
     ap.add_argument("--no-fail", action="store_true",
@@ -52,9 +58,11 @@ def main() -> int:
 
     from gofr_tpu.serving.engine import EngineConfig
     from gofr_tpu.serving.glue import demo_llama_engine
-    from gofr_tpu.serving.replay import load_workload, replay_workload
+    from gofr_tpu.serving.replay import (load_events, load_workload,
+                                         replay_workload)
 
     workload = load_workload(args.workload)
+    events = load_events(args.events) if args.events else None
     header = workload["header"]
     seed = args.seed if args.seed is not None \
         else header.get("engine_seed")
@@ -67,7 +75,7 @@ def main() -> int:
     try:
         report = replay_workload(engine, workload, speed=args.speed,
                                  closed_loop=args.closed_loop,
-                                 timeout_s=args.timeout)
+                                 timeout_s=args.timeout, events=events)
     finally:
         engine.stop()
     text = json.dumps(report, indent=2, default=str)
@@ -82,6 +90,20 @@ def main() -> int:
         print(f"# EFFICIENCY DIVERGED: {div['cause']} waste share "
               f"{div['recorded_share']:.1%} -> "
               f"{div['replayed_share']:.1%}", file=sys.stderr)
+    ev_div = report.get("event_divergence")
+    if ev_div and ev_div.get("diverged"):
+        # advisory like the efficiency diff: replay timing legitimately
+        # shifts some events, but a new kind (engine.restart where the
+        # capture had none) deserves a line even when tokens matched
+        for kind in ev_div.get("kinds_extra") or []:
+            print(f"# EVENTS DIVERGED: replay emitted {kind} the "
+                  "capture never saw", file=sys.stderr)
+        for kind in ev_div.get("kinds_missing") or []:
+            print(f"# EVENTS DIVERGED: capture's {kind} never fired "
+                  "in replay", file=sys.stderr)
+        for kind, cnt in (ev_div.get("count_divergence") or {}).items():
+            print(f"# EVENTS DIVERGED: {kind} x{cnt['recorded']} -> "
+                  f"x{cnt['replayed']}", file=sys.stderr)
     if report["divergent"] and not args.no_fail:
         print(f"# DIVERGED: {report['divergent']} request(s)",
               file=sys.stderr)

@@ -1,0 +1,21 @@
+"""hot-path-purity: flight-recorder events inlined in the hot loop —
+the anti-pattern serving/events.py exists to prevent. Lines matter —
+test_analysis.py pins them."""
+import time
+
+from gofr_tpu.analysis import hot_path
+
+
+class Engine:
+    @hot_path
+    def step(self, batch):
+        # ad-hoc event recording: wall-clock stamp, counter and log
+        # write from the dispatch path
+        self.ring.append({"ts": time.time(), "kind": "step"})   # L14
+        self.metrics.increment_counter("app_events_total")      # L15
+        self.logger.warn("event recorded", kind="step")         # L16
+        return self._stamp(batch)
+
+    def _stamp(self, batch):
+        # undecorated helper on the closure: its clock read flags too
+        return batch, time.time()                               # L21

@@ -1,0 +1,36 @@
+"""hot-path-purity: the clean twin — a ring-buffered EventLedger whose
+``emit`` is a @hot_path_boundary (the serving/events.py pattern): the
+purity walk stops at the ledger, so state transitions recorded from
+boundary code never drag clocks or counters into the hot closure.
+None of this may be flagged."""
+import time
+
+from gofr_tpu.analysis import hot_path, hot_path_boundary
+
+
+class EventLedger:
+    @hot_path_boundary("event emission: the ring append, wall-clock "
+                       "stamp and counters are host-side bookkeeping "
+                       "— the purity walk stops here by design")
+    def emit(self, kind, **attrs):
+        # inside the boundary anything goes — this models
+        # serving/events.py EventLedger.emit
+        event = {"ts": time.time(), "kind": kind, "attrs": attrs}
+        self.ring.append(event)
+        self.metrics.increment_counter("app_events_total", kind=kind)
+        return event
+
+
+NO_EVENTS = EventLedger()
+
+
+class Engine:
+    @hot_path
+    def step(self, batch):
+        # the recorded transition: one boundary call, nothing inline
+        if self.events is not NO_EVENTS:
+            self.events.emit("engine.step")
+        return self._advance(batch)
+
+    def _advance(self, batch):
+        return batch

@@ -120,6 +120,44 @@ class TestFaultInjectionSites:
         assert isinstance(reason, str) and reason.strip()
 
 
+# ----------------------------------------------- event-ledger contract
+class TestEventLedgerContract:
+    """The serving/events.py contract, lint-enforced: flight-recorder
+    emission is legal ONLY through the @hot_path_boundary
+    ``EventLedger.emit`` — inline ring appends, wall-clock stamps or
+    counters in a hot root (or a closure-reached helper) must flag."""
+
+    def test_inline_event_recording_flags(self):
+        got = violations(lint("events_bad.py"), "hot-path-purity")
+        lines = {f.line for f in got}
+        assert {14, 15, 16} <= lines          # inline stamp + telemetry
+        assert 21 in lines                    # closure-reached helper
+
+    def test_boundary_emission_is_clean(self):
+        assert violations(lint("events_good.py"), "hot-path-purity") == []
+
+    def test_live_emit_declares_a_boundary(self):
+        # the real module, not a fixture: EventLedger.emit must keep
+        # its boundary (with a reason) or every emission site would
+        # drag clocks, locks and counters into the hot closure
+        from gofr_tpu.serving.events import EventLedger
+        reason = getattr(EventLedger.emit,
+                         "__gofr_hot_path_boundary__", "")
+        assert isinstance(reason, str) and reason.strip()
+
+    def test_live_repo_hot_closure_excludes_events(self):
+        # with the ledger wired on by default, the engine's hot
+        # closure must not grow into events.py: emission is only
+        # reachable through already-declared boundary sites
+        from gofr_tpu.analysis.callgraph import CallGraph
+        from gofr_tpu.analysis.core import load_project
+        project = load_project([REPO / "gofr_tpu" / "serving"], root=REPO)
+        closure = CallGraph(project).hot_closure()
+        offenders = [str(k) for k in closure
+                     if k.module.endswith("events.py")]
+        assert not offenders, offenders
+
+
 # ----------------------------------------------------- router contract
 class TestRouterContract:
     """The serving/router.py contract, lint-enforced: the async proxy

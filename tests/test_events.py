@@ -1,0 +1,359 @@
+"""Flight data recorder (serving/events.py): ring bounds and drop
+accounting, the versioned JSONL contract, skew-corrected fleet merging
+with epoch tie-breaks, incident bundles for all three trigger reasons,
+and the hard invariant — the ledger + detector fully ON change not a
+single greedy token."""
+
+import json
+
+import pytest
+
+from gofr_tpu.container.container import Container
+from gofr_tpu.serving.engine import EngineConfig, SamplingParams
+from gofr_tpu.serving.events import (
+    EVENTS_FORMAT, EVENTS_VERSION, KINDS, NO_EVENTS, EventLedger,
+    EventLedgerConfig, FleetEventMerger, IncidentDetector,
+    event_timeline_diff, parse_events, resolve_ledger)
+from gofr_tpu.serving.glue import demo_llama_engine
+
+
+class FakeClock:
+    def __init__(self, now: float = 1000.0) -> None:
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+
+# ------------------------------------------------------- ring + drops
+class TestLedgerRing:
+    def test_ring_bound_and_per_kind_drop_accounting(self):
+        led = EventLedger(EventLedgerConfig(capacity=4), host="h1")
+        for _ in range(3):
+            led.emit("sched.reject", severity="warn", cause="shed")
+        for _ in range(7):
+            led.emit("engine.restart", severity="error")
+        assert len(led) == 4
+        state = led.state()
+        assert state["seq"] == 10
+        assert state["totals"] == {"sched.reject": 3,
+                                   "engine.restart": 7}
+        # 6 rotated out: the 3 rejects plus 3 restarts, by kind
+        assert state["dropped"] == {"sched.reject": 3,
+                                    "engine.restart": 3}
+        # the survivors are the NEWEST 4, oldest first
+        kept = [e["seq"] for e in led.snapshot()]
+        assert kept == [7, 8, 9, 10]
+
+    def test_emit_returns_record_with_optional_fields(self):
+        led = EventLedger(EventLedgerConfig(capacity=8), host="h1")
+        ev = led.emit("fleet.failover", severity="error", epoch=3,
+                      cause="takeover", trace_id="t" * 32, rank=1)
+        assert ev["host"] == "h1" and ev["epoch"] == 3
+        assert ev["trace_id"] == "t" * 32
+        assert ev["attrs"] == {"rank": 1}
+        plain = led.emit("engine.drain")
+        assert "attrs" not in plain and "epoch" not in plain
+
+    def test_unknown_kind_and_severity_raise(self):
+        led = EventLedger(EventLedgerConfig(capacity=2))
+        with pytest.raises(ValueError, match="unknown event kind"):
+            led.emit("engine.restrat")
+        with pytest.raises(ValueError, match="unknown severity"):
+            led.emit("engine.restart", severity="fatal")
+
+    def test_disabled_singleton_is_inert(self):
+        assert NO_EVENTS.emit("engine.restart") is None
+        assert not NO_EVENTS.enabled and len(NO_EVENTS) == 0
+        # disabled returns BEFORE validation: the hot guard costs two
+        # comparisons, never a set lookup
+        assert NO_EVENTS.emit("not-a-kind") is None
+
+    def test_emit_declares_metrics(self):
+        container = Container()
+        container.register_framework_metrics()
+        led = EventLedger(EventLedgerConfig(capacity=1),
+                          metrics=container.metrics)
+        led.emit("obs.recompile", severity="warn")
+        led.emit("obs.recompile", severity="warn")  # rotates the first
+        snap = container.metrics.snapshot()["metrics"]
+        totals = {tuple(sorted(s["labels"].items())): s["value"]
+                  for s in snap["app_events_total"]["series"]}
+        drops = {tuple(sorted(s["labels"].items())): s["value"]
+                 for s in snap["app_events_dropped"]["series"]}
+        assert totals[(("kind", "obs.recompile"),)] == 2.0
+        assert drops[(("kind", "obs.recompile"),)] == 1.0
+
+    def test_resolve_ledger_contract(self, monkeypatch):
+        assert resolve_ledger(False) is NO_EVENTS
+        assert resolve_ledger(
+            EventLedgerConfig(capacity=0)) is NO_EVENTS
+        led = EventLedger(EventLedgerConfig(capacity=2))
+        assert resolve_ledger(led) is led
+        assert resolve_ledger(None).enabled
+        monkeypatch.setenv("GOFR_EVENTS", "0")
+        assert resolve_ledger(None) is NO_EVENTS
+        with pytest.raises(TypeError):
+            resolve_ledger(42)
+
+
+# ---------------------------------------------------------- jsonl/v1
+class TestEventsFormat:
+    def test_jsonl_round_trip(self):
+        led = EventLedger(EventLedgerConfig(capacity=8), host="h1")
+        led.emit("engine.drain", cause="admission closed")
+        led.emit("engine.recovery", restart=1)
+        header, events = parse_events(led.to_jsonl())
+        assert header["format"] == EVENTS_FORMAT
+        assert header["version"] == EVENTS_VERSION
+        assert [e["kind"] for e in events] == ["engine.drain",
+                                               "engine.recovery"]
+
+    def test_unknown_format_and_version_refused(self):
+        led = EventLedger(EventLedgerConfig(capacity=2))
+        led.emit("engine.drain")
+        good = led.to_jsonl().splitlines()
+        bad_fmt = dict(json.loads(good[0]), format="gofr-workload")
+        with pytest.raises(ValueError, match="format"):
+            parse_events("\n".join([json.dumps(bad_fmt)] + good[1:]))
+        bad_ver = dict(json.loads(good[0]), version=99)
+        with pytest.raises(ValueError, match="version"):
+            parse_events("\n".join([json.dumps(bad_ver)] + good[1:]))
+
+    def test_filters(self):
+        clock = FakeClock()
+        led = EventLedger(EventLedgerConfig(capacity=16), clock=clock)
+        led.emit("sched.reject")
+        clock.now += 10
+        led.emit("sched.reject")
+        led.emit("engine.restart")
+        assert len(led.snapshot(kind="sched.reject")) == 2
+        assert len(led.snapshot(since=clock.now)) == 2
+        assert [e["kind"] for e in led.snapshot(n=1)] \
+            == ["engine.restart"]
+
+
+# ------------------------------------------------------- fleet merge
+class TestFleetMerge:
+    def test_skew_correction_orders_across_hosts(self):
+        # host A's clock runs 100s fast; without correction its events
+        # sort far in the future. The merger estimates the offset from
+        # digest["now"] vs. arrival time and corrects it away.
+        merge_clock = FakeClock(2000.0)
+        merger = FleetEventMerger(clock=merge_clock)
+        clock_a = FakeClock(2100.0)  # +100s skew
+        clock_b = FakeClock(2000.0)  # true time
+        led_a = EventLedger(EventLedgerConfig(capacity=16),
+                            host="a", clock=clock_a)
+        led_b = EventLedger(EventLedgerConfig(capacity=16),
+                            host="b", clock=clock_b)
+        led_a.emit("fleet.failover", severity="warn", epoch=2)
+        clock_a.now += 5
+        clock_b.now += 5
+        merge_clock.now += 5
+        led_b.emit("engine.recovery")
+        merger.ingest("a", led_a.digest())
+        merger.ingest("b", led_b.digest())
+        timeline = merger.timeline()
+        assert [e["kind"] for e in timeline] \
+            == ["fleet.failover", "engine.recovery"]
+        skews = {e["host"]: e["skew_s"] for e in timeline}
+        assert skews["a"] == pytest.approx(-100.0, abs=1e-6)
+        assert skews["b"] == pytest.approx(0.0, abs=1e-6)
+
+    def test_epoch_breaks_timestamp_ties(self):
+        clock = FakeClock(3000.0)
+        merger = FleetEventMerger(clock=clock)
+        led_new = EventLedger(EventLedgerConfig(capacity=8),
+                              host="new", clock=clock)
+        led_old = EventLedger(EventLedgerConfig(capacity=8),
+                              host="old", clock=clock)
+        # same instant on both clocks: the fence reject at epoch 1
+        # must sort BEFORE the takeover commit at epoch 2
+        led_old.emit("fleet.fence_reject", severity="warn", epoch=1)
+        led_new.emit("fleet.epoch_bump", epoch=2)
+        merger.ingest("new", led_new.digest())
+        merger.ingest("old", led_old.digest())
+        assert [e["kind"] for e in merger.timeline()] \
+            == ["fleet.fence_reject", "fleet.epoch_bump"]
+
+    def test_digest_dedup_and_per_host_bound(self):
+        clock = FakeClock()
+        merger = FleetEventMerger(capacity_per_host=4, clock=clock)
+        led = EventLedger(EventLedgerConfig(capacity=16, digest_size=16),
+                          host="a", clock=clock)
+        for _ in range(3):
+            led.emit("sched.reject")
+        merger.ingest("a", led.digest())
+        merger.ingest("a", led.digest())  # same events re-delivered
+        assert len(merger.timeline()) == 3
+        for _ in range(4):
+            led.emit("engine.restart")
+        merger.ingest("a", led.digest())
+        assert len(merger.timeline()) == 4  # bounded, oldest evicted
+
+    def test_merger_backfills_missing_host(self):
+        # engine ledgers default host="" — the heartbeat's host_id is
+        # authoritative for attribution
+        clock = FakeClock()
+        merger = FleetEventMerger(clock=clock)
+        led = EventLedger(EventLedgerConfig(capacity=8), clock=clock)
+        led.emit("engine.drain")
+        merger.ingest("worker-7", led.digest())
+        assert merger.timeline()[0]["host"] == "worker-7"
+
+
+# ---------------------------------------------------------- incidents
+def make_detector(clock, **cfg):
+    config = EventLedgerConfig(**cfg)
+    led = EventLedger(config, host="h1", clock=clock)
+    det = IncidentDetector(config, ledger=led, host="h1", clock=clock)
+    return led, det
+
+
+class TestIncidents:
+    @pytest.mark.parametrize("reason", IncidentDetector.REASONS)
+    def test_each_reason_opens_a_bundle(self, reason):
+        clock = FakeClock()
+        led, det = make_detector(clock)
+        meta = det.trigger(reason, cause="test")
+        assert meta is not None and meta["reason"] == reason
+        # the trigger itself lands on the ledger as incident.open
+        opened = led.snapshot(kind="incident.open")
+        assert len(opened) == 1 and opened[0]["cause"] == reason
+
+    def test_unknown_reason_raises(self):
+        _, det = make_detector(FakeClock())
+        with pytest.raises(ValueError, match="unknown incident reason"):
+            det.trigger("leaky_abstraction")
+
+    def test_debounce_per_reason(self):
+        clock = FakeClock()
+        _, det = make_detector(clock, incident_debounce_s=30.0)
+        assert det.trigger("fast_burn") is not None
+        assert det.trigger("fast_burn") is None  # debounced
+        assert det.trigger("failover") is not None  # other reason OK
+        clock.now += 31.0
+        assert det.trigger("fast_burn") is not None
+        assert det.state()["debounced"] == {"fast_burn": 1}
+
+    def test_bundle_completeness_and_lazy_seal(self):
+        clock = FakeClock()
+        led, det = make_detector(clock, incident_window_s=60.0,
+                                 incident_debounce_s=0.0)
+        det.sources["goodput"] = lambda: {"busy_s": 1.0}
+        det.sources["broken"] = lambda: 1 / 0
+        led.emit("obs.fast_burn", severity="error")
+        meta = det.trigger("fast_burn", cause="burn 14.4x",
+                           trace_id="a" * 32)
+        bundle = det.get(meta["id"])
+        assert bundle["format"] == "gofr-incident"
+        assert bundle["reason"] == "fast_burn"
+        assert bundle["trace_id"] == "a" * 32
+        assert bundle["state"]["goodput"] == {"busy_s": 1.0}
+        assert "ZeroDivisionError" in bundle["state"]["broken"]["error"]
+        assert "commit" in bundle["git"] and "ref" in bundle["git"]
+        assert bundle["ledger"]["enabled"] is True
+        kinds = [e["kind"] for e in bundle["timeline"]]
+        assert "obs.fast_burn" in kinds
+        assert bundle["sealed"] is False  # window still open
+        # an event INSIDE the post-trigger window tops up on read ...
+        clock.now += 10.0
+        led.emit("engine.restart", severity="error")
+        clock.now += 61.0
+        led.emit("engine.recovery")  # ... one outside it does not
+        sealed = det.get(meta["id"])
+        kinds = [e["kind"] for e in sealed["timeline"]]
+        assert sealed["sealed"] is True
+        assert "engine.restart" in kinds
+        assert "engine.recovery" not in kinds
+
+    def test_spool_bound_and_disk_mirror(self, tmp_path):
+        clock = FakeClock()
+        config = EventLedgerConfig(spool_max=2, incident_debounce_s=0.0,
+                                   spool_dir=str(tmp_path))
+        led = EventLedger(config, host="h1", clock=clock)
+        det = IncidentDetector(config, ledger=led, host="h1",
+                               clock=clock)
+        ids = []
+        for reason in ("fast_burn", "failover", "restart_budget"):
+            ids.append(det.trigger(reason)["id"])
+            clock.now += 1.0
+        listed = [m["id"] for m in det.list()]
+        assert listed == ids[1:]  # oldest pruned at spool_max=2
+        assert det.get(ids[0]) is None
+        on_disk = sorted(p.name for p in tmp_path.glob("*.json"))
+        assert on_disk == sorted(f"incident-{i}.json" for i in ids[1:])
+        doc = json.loads(
+            (tmp_path / f"incident-{ids[1]}.json").read_text())
+        assert doc["id"] == ids[1]
+
+
+# -------------------------------------------------------- replay diff
+class TestTimelineDiff:
+    def test_identical_timelines_do_not_diverge(self):
+        evs = [{"kind": "engine.drain"}, {"kind": "engine.recovery"}]
+        diff = event_timeline_diff(evs, list(evs))
+        assert diff["diverged"] is False
+
+    def test_missing_extra_count_and_order(self):
+        rec = [{"kind": "sched.reject"}, {"kind": "sched.reject"},
+               {"kind": "engine.drain"}]
+        rep = [{"kind": "sched.reject"}, {"kind": "engine.restart"}]
+        diff = event_timeline_diff(rec, rep)
+        assert diff["diverged"] is True
+        assert diff["kinds_missing"] == ["engine.drain"]
+        assert diff["kinds_extra"] == ["engine.restart"]
+        assert diff["count_divergence"]["sched.reject"] \
+            == {"recorded": 2, "replayed": 1}
+        assert diff["order_divergence"] == {
+            "index": 1, "recorded": "sched.reject",
+            "replayed": "engine.restart"}
+
+
+# --------------------------------------------- zero-perturbation proof
+def _greedy_tokens(events_knob):
+    eng = demo_llama_engine(EngineConfig(
+        max_batch=2, max_seq=64, seed=7, events=events_knob))
+    eng.start()
+    sp = SamplingParams(temperature=0.0, max_new_tokens=12)
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7]]
+    reqs = [eng.submit(p, sp) for p in prompts]
+    import time as _time
+    deadline = _time.time() + 120
+    while _time.time() < deadline and any(
+            r.finished_at is None and r.error is None for r in reqs):
+        _time.sleep(0.005)
+    eng.stop()
+    assert all(r.error is None for r in reqs), [r.error for r in reqs]
+    return [list(r.generated) for r in reqs], eng
+
+
+def test_ledger_and_detector_on_change_no_greedy_token():
+    """The acceptance invariant: flight recorder fully ON (default
+    ledger + incident detector wired by the engine) produces the exact
+    token streams of a ledger-less engine."""
+    base, _ = _greedy_tokens(False)
+    with_events, eng = _greedy_tokens(True)
+    assert base == with_events
+    assert eng.events.enabled
+    assert eng.incidents is not None
+
+
+def test_kind_catalog_matches_emitters():
+    """Every kind the serving modules emit is in the catalog, and the
+    catalog carries no dead kinds (a typo'd emitter raises at emit
+    time, but a stale catalog entry rots silently — this pins both)."""
+    import re
+    from pathlib import Path
+    serving = Path(__file__).resolve().parent.parent \
+        / "gofr_tpu" / "serving"
+    emitted = set()
+    for path in serving.glob("*.py"):
+        emitted.update(re.findall(
+            r"\.emit\(\s*['\"]([a-z_.]+)['\"]", path.read_text()))
+    assert emitted, "no emit sites found — the scan regex broke"
+    unknown = sorted(emitted - KINDS)
+    assert not unknown, f"emitted kinds missing from KINDS: {unknown}"
+    dead = sorted(KINDS - emitted)
+    assert not dead, f"catalog kinds nothing emits: {dead}"

@@ -63,12 +63,14 @@ def test_every_serving_metric_write_is_registered():
 
     container = Container()
     container.register_framework_metrics()
-    # tenant metering + SLO series must live in the CONTAINER framework
-    # set (not only attach_metrics): federation merges them across
-    # hosts and leaders/aggregators never call attach_metrics
+    # tenant metering + SLO + fleet/router + event-ledger series must
+    # live in the CONTAINER framework set (not only attach_metrics):
+    # federation merges them across hosts and leaders/aggregators
+    # never call attach_metrics
     framework_missing = sorted(
         n for n in written
-        if n.startswith(("app_tenant_", "app_slo_"))
+        if n.startswith(("app_tenant_", "app_slo_", "app_fleet_",
+                         "app_router_", "app_events_"))
         and container.metrics.get(n) is None)
     assert not framework_missing, (
         f"tenant/SLO metric(s) written in serving/ but absent from the "
