@@ -432,6 +432,66 @@ if not on_accel:
         "prod-shaped smoke scenario never engaged speculative "
         f"decoding: {prod_payload}")
 
+# kv-capacity scenario (quantized KV pages): at ONE fixed pool byte
+# budget, how many resident sessions fit and what does decode run at,
+# bf16 vs int8 KV (EngineConfig.kv_dtype)? Capacity is what int8 KV
+# buys — per-row HBM drops from native-dtype*hd to hd+4 bytes — and
+# the ratio is dtype arithmetic, so the CPU smoke can enforce it.
+kv_sess_len = prompt_len + gen_len
+kv_pages_per_sess = -(-kv_sess_len // page)
+kv_row_native = (2 * model_config.n_layers * model_config.n_kv_heads
+                 * model_config.head_dim
+                 * jnp.dtype(model_config.dtype).itemsize)
+# budget = exactly max_batch resident sessions at the NATIVE page cost
+kv_budget = max_batch * kv_pages_per_sess * page * kv_row_native
+kv_n = max_batch
+
+
+def kv_run(dt):
+    cfg = EngineConfig(max_batch=max_batch, max_seq=model_config.max_seq,
+                       prefill_buckets=(64, 128, 256, 512), seed=0,
+                       kv_layout="paged", page_size=page,
+                       kv_dtype=dt, kv_pool_bytes=kv_budget)
+    engine = llama_engine(params, model_config, cfg, quantize=quant)
+    sessions = engine._n_pages // kv_pages_per_sess
+    kv_bytes = engine.efficiency_state()["kv_bytes"]
+    engine.warmup(prompt_lens=(prompt_len,))
+    engine.start()
+    sp = SamplingParams(temperature=0.0, max_new_tokens=gen_len)
+    t0 = time.time()
+    reqs = [engine.submit(prompt, sp) for _ in range(kv_n)]
+    deadline = t0 + 300.0
+    while any(r.finished_at is None and r.error is None for r in reqs):
+        if time.time() > deadline:
+            engine.stop()
+            raise TimeoutError("kv-capacity run did not finish in 300s")
+        time.sleep(0.001)
+    wall = time.time() - t0
+    engine.stop()
+    toks = sum(len(r.generated) for r in reqs if r.error is None)
+    return sessions, int(kv_bytes), round(toks / wall, 1)
+
+
+try:
+    kv_sess_b, kv_bytes_b, kv_tps_b = kv_run("bf16")
+    kv_sess_i, kv_bytes_i, kv_tps_i = kv_run("int8")
+    kv_payload = {
+        "budget_bytes": int(kv_budget),
+        "sessions_bf16": kv_sess_b, "sessions_int8": kv_sess_i,
+        "capacity_ratio": round(kv_sess_i / max(1, kv_sess_b), 3),
+        "tok_per_s_bf16": kv_tps_b, "tok_per_s_int8": kv_tps_i,
+        "kv_bytes_bf16": kv_bytes_b, "kv_bytes_int8": kv_bytes_i,
+    }
+except Exception as exc:  # the headline number must survive this
+    kv_payload = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+print(f"# kv-capacity: {kv_payload}", file=sys.stderr)
+if not on_accel:
+    # the capacity claim is deterministic dtype arithmetic (per-row
+    # bytes native*hd vs hd+4): the CPU smoke enforces >= 1.8x so a
+    # sizing regression kills the bench, not just a trajectory number
+    assert kv_payload.get("capacity_ratio", 0.0) >= 1.8, (
+        f"int8 KV pool holds < 1.8x the bf16 sessions: {kv_payload}")
+
 print("BENCH_JSON " + json.dumps({
     "metric": "chat_req_per_s",
     "value": round(req_per_s, 2),
@@ -464,6 +524,7 @@ print("BENCH_JSON " + json.dumps({
     "decode_overhead": decode_payload,
     "prefill_ttft": ttft_payload,
     "prod_shaped": prod_payload,
+    "kv_capacity": kv_payload,
 }))
 """
 
@@ -502,6 +563,14 @@ def headline_metrics(payload: dict) -> dict:
     prod = payload.get("prod_shaped") or {}
     put("prod_tok_per_s", prod.get("tok_per_s"))
     put("prod_req_per_s", prod.get("req_per_s"))
+    # kv_* keys are capacity numbers, not throughput: bench_compare
+    # reports them but never gates (not in THROUGHPUT_KEYS, not *_ms)
+    kvc = payload.get("kv_capacity") or {}
+    put("kv_sessions_bf16", kvc.get("sessions_bf16"))
+    put("kv_sessions_int8", kvc.get("sessions_int8"))
+    put("kv_capacity_ratio", kvc.get("capacity_ratio"))
+    put("kv_tok_per_s_bf16", kvc.get("tok_per_s_bf16"))
+    put("kv_tok_per_s_int8", kvc.get("tok_per_s_int8"))
     goodput = payload.get("goodput") or {}
     put("goodput_ratio", goodput.get("goodput_ratio"))
     # busy_s rides along so the compare gate can tell a statistically

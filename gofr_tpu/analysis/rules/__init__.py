@@ -4,8 +4,10 @@ CLI and the analyzer driver iterate."""
 
 from __future__ import annotations
 
-from . import async_blocking, hot_path, locks, metric_hygiene, recompile
+from . import (async_blocking, hot_path, kv_quant, locks,
+               metric_hygiene, recompile)
 
-ALL_RULES = (hot_path, locks, async_blocking, metric_hygiene, recompile)
+ALL_RULES = (hot_path, locks, async_blocking, metric_hygiene, recompile,
+             kv_quant)
 
 RULE_IDS = tuple(r.RULE_ID for r in ALL_RULES)

@@ -298,14 +298,17 @@ def llama_decode_step_paged(params: dict, tokens: jnp.ndarray,
     is only ever touched in place. pools [L, Hkv, Np, pg, hd]
     (head-major — see ops/paged_kv.py); tables [B, Mp]; lengths [B] =
     rows already cached (the new token lands at that position).
-    Returns (logits [B, V], new_k_pool, new_v_pool).
+    Returns (logits [B, V], new_k_pool, new_v_pool). Quantized pools
+    (the ``{"q", "s"}`` pytree from ops/paged_kv.py) ride the same
+    scan: writes quantize inside :func:`..ops.paged_kv.pool_write` and
+    the ragged kernel dequantizes per page.
     """
     from ..ops.paged_attention import paged_decode_attention
+    from ..ops.paged_kv import pool_layer, pool_shape, pool_write
     c = config
     b = tokens.shape[0]
     hd = c.head_dim
-    pg = k_pool.shape[3]
-    n_pages = k_pool.shape[2]
+    n_pages, pg = pool_shape(k_pool)[2:4]
     inv_freq = rope_frequencies(c.head_dim, c.rope_theta, c.rope_scaling)
     positions = lengths[:, None]
     x = qgather(params["embed"], tokens, c.dtype)[:, None, :]  # [B, 1, D]
@@ -332,12 +335,10 @@ def llama_decode_step_paged(params: dict, tokens: jnp.ndarray,
         v = qmatmul(h, lp["wv"]).reshape(b, 1, c.n_kv_heads, hd)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
-        kp_all = kp_all.at[li, :, pids, offs].set(
-            k[:, 0].astype(kp_all.dtype), mode="drop")
-        vp_all = vp_all.at[li, :, pids, offs].set(
-            v[:, 0].astype(vp_all.dtype), mode="drop")
-        kp = jax.lax.dynamic_index_in_dim(kp_all, li, 0, keepdims=False)
-        vp = jax.lax.dynamic_index_in_dim(vp_all, li, 0, keepdims=False)
+        kp_all = pool_write(kp_all, li, pids, offs, k[:, 0])
+        vp_all = pool_write(vp_all, li, pids, offs, v[:, 0])
+        kp = pool_layer(kp_all, li)
+        vp = pool_layer(vp_all, li)
         out = paged_decode_attention(q[:, 0], kp, vp, tables, lengths + 1,
                                      implementation=implementation)
         x = x + qmatmul(out.reshape(b, 1, c.n_heads * hd), lp["wo"])
@@ -455,11 +456,11 @@ def llama_prefill_chunk_paged(params: dict, tokens: jnp.ndarray,
     new_v_pool); pools are meant to be donated.
     """
     from ..ops.paged_attention import paged_chunk_attention
+    from ..ops.paged_kv import pool_layer, pool_shape, pool_write
     c = config
     b, s = tokens.shape
     hd = c.head_dim
-    pg = k_pool.shape[3]
-    n_pages = k_pool.shape[2]
+    n_pages, pg = pool_shape(k_pool)[2:4]
     mp = tables.shape[1]
     inv_freq = rope_frequencies(c.head_dim, c.rope_theta, c.rope_scaling)
     positions = offsets[:, None] + jnp.arange(s)[None, :]      # [B, S]
@@ -485,12 +486,10 @@ def llama_prefill_chunk_paged(params: dict, tokens: jnp.ndarray,
         v = qmatmul(h, lp["wv"]).reshape(b, s, c.n_kv_heads, hd)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
-        kp_all = kp_all.at[li, :, pids, offs].set(
-            k.astype(kp_all.dtype), mode="drop")
-        vp_all = vp_all.at[li, :, pids, offs].set(
-            v.astype(vp_all.dtype), mode="drop")
-        kp = jax.lax.dynamic_index_in_dim(kp_all, li, 0, keepdims=False)
-        vp = jax.lax.dynamic_index_in_dim(vp_all, li, 0, keepdims=False)
+        kp_all = pool_write(kp_all, li, pids, offs, k)
+        vp_all = pool_write(vp_all, li, pids, offs, v)
+        kp = pool_layer(kp_all, li)
+        vp = pool_layer(vp_all, li)
         out = paged_chunk_attention(q, kp, vp, tables, offsets,
                                     chunk_lengths,
                                     implementation=implementation)

@@ -32,6 +32,16 @@ Layouts (decode, Sq == 1):
 (kernel under the interpreter — CPU tests), 'xla' (gather fallback),
 'auto' (pallas on TPU, xla elsewhere).
 
+Quantized pools (``kv_dtype="int8"``) arrive as the two-leaf pytree
+``{"q": int8 [Hkv, Np, pg, hd], "s": f32 [Hkv, Np, pg, 1]}`` from
+:mod:`.paged_kv`. The kernels DMA each int8 page PLUS its [pg, 1]
+scale row (hd+4 bytes per row instead of 2·hd — roughly half the
+per-page HBM traffic at hd >= 64) and dequantize in-register
+(``codes.astype(f32) * scales``) before the QK/PV matmuls. The
+``_xla`` fallbacks and interpret mode dequantize the same way, so the
+CPU parity tests compare identical float inputs — the quantization
+error cancels and kernel-vs-fallback parity is as tight as bf16's.
+
 The same shape generalises to ragged QUERY blocks
 (``paged_chunk_attention``): chunked prefill, prefix-cache suffix
 reattachment and speculative verify all feed Sq > 1 new positions per
@@ -80,18 +90,36 @@ def _pad_group(group: int, block_q: int = 1) -> int:
     return -(-group // step) * step
 
 
-def _check_page_alignment(page: int, interpret: bool) -> None:
+#: int8 memrefs tile the sublane dim in units of 32 rows (vs 8 for
+#: f32/bf16) — see the dtype tiling table in the Pallas TPU docs — so
+#: a quantized pool's page size must be a multiple of 32 for the
+#: per-page slices of the int8 double buffer to stay tile-aligned.
+SUBLANE_INT8 = 32
+
+
+def _check_page_alignment(page: int, interpret: bool,
+                          quantized: bool = False) -> None:
     """The per-page DMA lands each page at row offset ``j * page`` of
     the VMEM double buffer — a slice along the sublane dim, so the
     page size must be tile-aligned on real hardware (interpret mode on
-    CPU has no tiling). The engine's default page_size=64 is fine;
-    this turns a cryptic Mosaic error into an actionable one."""
-    if not interpret and page % SUBLANE:
+    CPU has no tiling). The engine's default page_size=64 is fine for
+    both dtypes; this turns a cryptic Mosaic error into an actionable
+    one."""
+    sublane = SUBLANE_INT8 if quantized else SUBLANE
+    if not interpret and page % sublane:
         raise ValueError(
-            f"page size {page} is not a multiple of {SUBLANE}: the TPU "
-            f"kernel DMAs whole pages into sublane-tiled VMEM — use a "
-            f"page_size multiple of {SUBLANE} (or the 'xla'/'view' "
-            f"path)")
+            f"page size {page} is not a multiple of {sublane}: the TPU "
+            f"kernel DMAs whole pages into sublane-tiled VMEM "
+            f"({'int8 tiles 32 rows' if quantized else '8-row tiles'}) "
+            f"— use a page_size multiple of {sublane} (or the "
+            f"'xla'/'view' path)")
+
+
+def _split_pool(pool):
+    """(codes, scales-or-None) for either pool representation."""
+    if isinstance(pool, dict):
+        return pool["q"], pool["s"]
+    return pool, None
 
 
 def _is_tpu() -> bool:
@@ -104,47 +132,55 @@ def _is_tpu() -> bool:
 # ------------------------------------------------------------------ kernel
 
 def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_hbm, v_hbm,
-                         o_ref, k_buf, v_buf, acc_ref, m_ref, l_ref,
-                         sems, *, page: int, pages_per_chunk: int,
-                         max_pages: int, n_pages: int, scale: float):
+                         *rest, page: int, pages_per_chunk: int,
+                         max_pages: int, n_pages: int, scale: float,
+                         quantized: bool = False):
+    if quantized:
+        (ks_hbm, vs_hbm, o_ref, k_buf, v_buf, ks_buf, vs_buf,
+         acc_ref, m_ref, l_ref, sems) = rest
+    else:
+        o_ref, k_buf, v_buf, acc_ref, m_ref, l_ref, sems = rest
     b = pl.program_id(0)
     h = pl.program_id(1)
     chunk = pages_per_chunk * page
     length = lengths_ref[b]
     n_chunks = jnp.maximum(pl.cdiv(length, chunk), 1)
 
-    def start_chunk(ci, slot):
+    def page_dmas(ci, slot):
         # one DMA per page: pages are scattered in the pool, so a
         # chunk is pages_per_chunk independent copies — each a
-        # CONTIGUOUS [page, hd] block in the head-major pool
+        # CONTIGUOUS [page, hd] block in the head-major pool. A
+        # quantized pool adds the [page, 1] f32 scale row per page.
+        dmas = []
         for j in range(pages_per_chunk):
             # tail chunks index past the table: clamp — their rows are
             # masked off by `length` below, they just must not fault
             page_idx = jnp.minimum(ci * pages_per_chunk + j,
                                    max_pages - 1)
             pid = jnp.minimum(tables_ref[b, page_idx], n_pages - 1)
-            pltpu.make_async_copy(
-                k_hbm.at[h, pid],
-                k_buf.at[slot, pl.ds(j * page, page), :],
-                sems.at[slot, 0, j]).start()
-            pltpu.make_async_copy(
-                v_hbm.at[h, pid],
-                v_buf.at[slot, pl.ds(j * page, page), :],
-                sems.at[slot, 1, j]).start()
+            dst = pl.ds(j * page, page)
+            dmas.append(pltpu.make_async_copy(
+                k_hbm.at[h, pid], k_buf.at[slot, dst, :],
+                sems.at[slot, 0, j]))
+            dmas.append(pltpu.make_async_copy(
+                v_hbm.at[h, pid], v_buf.at[slot, dst, :],
+                sems.at[slot, 1, j]))
+            if quantized:
+                dmas.append(pltpu.make_async_copy(
+                    ks_hbm.at[h, pid], ks_buf.at[slot, dst, :],
+                    sems.at[slot, 2, j]))
+                dmas.append(pltpu.make_async_copy(
+                    vs_hbm.at[h, pid], vs_buf.at[slot, dst, :],
+                    sems.at[slot, 3, j]))
+        return dmas
+
+    def start_chunk(ci, slot):
+        for dma in page_dmas(ci, slot):
+            dma.start()
 
     def wait_chunk(ci, slot):
-        for j in range(pages_per_chunk):
-            page_idx = jnp.minimum(ci * pages_per_chunk + j,
-                                   max_pages - 1)
-            pid = jnp.minimum(tables_ref[b, page_idx], n_pages - 1)
-            pltpu.make_async_copy(
-                k_hbm.at[h, pid],
-                k_buf.at[slot, pl.ds(j * page, page), :],
-                sems.at[slot, 0, j]).wait()
-            pltpu.make_async_copy(
-                v_hbm.at[h, pid],
-                v_buf.at[slot, pl.ds(j * page, page), :],
-                sems.at[slot, 1, j]).wait()
+        for dma in page_dmas(ci, slot):
+            dma.wait()
 
     m_ref[:] = jnp.full_like(m_ref, NEG_INF)
     l_ref[:] = jnp.zeros_like(l_ref)
@@ -162,6 +198,8 @@ def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_hbm, v_hbm,
 
         wait_chunk(ci, slot)
         k = k_buf[slot].astype(jnp.float32)             # [chunk, hd]
+        if quantized:
+            k = k * ks_buf[slot]        # in-register dequant, [chunk, 1]
         s = jax.lax.dot_general(
             qf, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)         # [G, chunk]
@@ -176,9 +214,11 @@ def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_hbm, v_hbm,
         # slot), s == m_new == NEG_INF and exp(s - m_new) would be 1
         p = jnp.where(pos < length, jnp.exp(s - m_new), 0.0)  # [G, chunk]
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_buf[slot].astype(jnp.float32)             # [chunk, hd]
+        if quantized:
+            v = v * vs_buf[slot]
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v_buf[slot].astype(jnp.float32),
-            (((1,), (0,)), ((), ())),
+            p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)         # [G, hd]
         m_ref[:] = m_new
         return 0
@@ -188,18 +228,22 @@ def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_hbm, v_hbm,
     o_ref[0, 0] = (acc_ref[:] / denom).astype(o_ref.dtype)
 
 
-def paged_decode_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
-                                  v_pool: jnp.ndarray, tables: jnp.ndarray,
+def paged_decode_attention_pallas(q: jnp.ndarray, k_pool,
+                                  v_pool, tables: jnp.ndarray,
                                   lengths: jnp.ndarray, *,
                                   scale: float | None = None,
                                   interpret: bool = False) -> jnp.ndarray:
-    """The Pallas path. q [B, Hq, hd], pools [Hkv, Np, pg, hd]."""
+    """The Pallas path. q [B, Hq, hd], pools [Hkv, Np, pg, hd] (plain)
+    or the ``{"q", "s"}`` quantized pytree."""
+    k_codes, k_scales = _split_pool(k_pool)
+    v_codes, v_scales = _split_pool(v_pool)
+    quantized = k_scales is not None
     b, hq, hd = q.shape
-    hkv, n_pages, page, _ = k_pool.shape
+    hkv, n_pages, page, _ = k_codes.shape
     _, max_pages = tables.shape
     group = hq // hkv
     scale = scale if scale is not None else hd ** -0.5
-    _check_page_alignment(page, interpret)
+    _check_page_alignment(page, interpret, quantized)
 
     # chunk ~128 rows per softmax fold, in whole pages
     pages_per_chunk = max(1, min(max_pages, -(-128 // page)))
@@ -214,7 +258,14 @@ def paged_decode_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
         q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, group_p - group), (0, 0)))
     kernel = functools.partial(
         _paged_decode_kernel, page=page, pages_per_chunk=pages_per_chunk,
-        max_pages=max_pages, n_pages=n_pages, scale=scale)
+        max_pages=max_pages, n_pages=n_pages, scale=scale,
+        quantized=quantized)
+    # scale rows ride as two extra HBM operands + two f32 double
+    # buffers; the semaphore array gains a pair of rows for them
+    scale_specs = [pl.BlockSpec(memory_space=pl.ANY)] * 2 \
+        if quantized else []
+    scale_bufs = [pltpu.VMEM((2, chunk, 1), jnp.float32)] * 2 \
+        if quantized else []
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, hkv),
@@ -224,19 +275,26 @@ def paged_decode_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),      # k pool stays in HBM
             pl.BlockSpec(memory_space=pl.ANY),      # v pool stays in HBM
+            *scale_specs,
         ],
         out_specs=pl.BlockSpec((1, 1, group_p, hd),
                                lambda i, j, *_: (i, j, 0, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((2, chunk, hd), k_pool.dtype),
-            pltpu.VMEM((2, chunk, hd), v_pool.dtype),
+            pltpu.VMEM((2, chunk, hd), k_codes.dtype),
+            pltpu.VMEM((2, chunk, hd), v_codes.dtype),
+            *scale_bufs,
             pltpu.VMEM((group_p, hd), jnp.float32),
             pltpu.VMEM((group_p, 1), jnp.float32),
             pltpu.VMEM((group_p, 1), jnp.float32),
-            pltpu.SemaphoreType.DMA((2, 2, pages_per_chunk)),
+            pltpu.SemaphoreType.DMA((2, 4 if quantized else 2,
+                                     pages_per_chunk)),
         ],
     )
+    args = [tables.astype(jnp.int32), lengths.astype(jnp.int32),
+            q4, k_codes, v_codes]
+    if quantized:
+        args += [k_scales, v_scales]
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((b, hkv, group_p, hd), q.dtype),
@@ -247,8 +305,7 @@ def paged_decode_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel")),
         interpret=interpret,
-    )(tables.astype(jnp.int32), lengths.astype(jnp.int32),
-      q4, k_pool, v_pool)
+    )(*args)
     if group_p != group:
         out = out[:, :, :group]
     return out.reshape(b, hq, hd)
@@ -256,20 +313,35 @@ def paged_decode_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
 
 # ------------------------------------------------------------ xla fallback
 
-def paged_decode_attention_xla(q: jnp.ndarray, k_pool: jnp.ndarray,
-                               v_pool: jnp.ndarray, tables: jnp.ndarray,
+def _slot_view(pool, tables: jnp.ndarray) -> jnp.ndarray:
+    """Gather one layer's pool into the dense slot view
+    [B, Mp*pg, Hkv, hd]. Quantized pools dequantize here with exactly
+    the kernels' ``codes.astype(f32) * scales`` contraction, so the
+    fallback sees identical float values."""
+    codes, scales = _split_pool(pool)
+    hkv, n_pages, page, _ = codes.shape
+    b, max_pages = tables.shape
+    safe = jnp.minimum(tables, n_pages - 1)
+
+    def gather(x):
+        return x[:, safe].transpose(1, 2, 3, 0, 4).reshape(
+            b, max_pages * page, hkv, x.shape[-1])
+
+    view = gather(codes)
+    if scales is not None:
+        view = view.astype(jnp.float32) * gather(scales)
+    return view
+
+
+def paged_decode_attention_xla(q: jnp.ndarray, k_pool,
+                               v_pool, tables: jnp.ndarray,
                                lengths: jnp.ndarray, *,
                                scale: float | None = None) -> jnp.ndarray:
     """Reference path: gather the slot views, run dense masked decode
     attention. Correct everywhere; materialises [B, Mp*pg, Hkv, hd]."""
     from .attention import decode_attention
-    hkv, n_pages, page, hd = k_pool.shape
-    b, max_pages = tables.shape
-    safe = jnp.minimum(tables, n_pages - 1)
-    k_view = k_pool[:, safe].transpose(1, 2, 3, 0, 4).reshape(
-        b, max_pages * page, hkv, hd)
-    v_view = v_pool[:, safe].transpose(1, 2, 3, 0, 4).reshape(
-        b, max_pages * page, hkv, hd)
+    k_view = _slot_view(k_pool, tables)
+    v_view = _slot_view(v_pool, tables)
     return decode_attention(q[:, None], k_view, v_view, lengths,
                             scale=scale)[:, 0]
 
@@ -277,11 +349,15 @@ def paged_decode_attention_xla(q: jnp.ndarray, k_pool: jnp.ndarray,
 # ----------------------------------------------------- chunk (Sq > 1)
 
 def _paged_chunk_kernel(tables_ref, history_ref, chunk_ref, q_ref,
-                        k_hbm, v_hbm, o_ref, k_buf, v_buf, acc_ref,
-                        m_ref, l_ref, sems, *, page: int,
+                        k_hbm, v_hbm, *rest, page: int,
                         pages_per_chunk: int, max_pages: int,
                         n_pages: int, scale: float, block_q: int,
-                        group: int):
+                        group: int, quantized: bool = False):
+    if quantized:
+        (ks_hbm, vs_hbm, o_ref, k_buf, v_buf, ks_buf, vs_buf,
+         acc_ref, m_ref, l_ref, sems) = rest
+    else:
+        o_ref, k_buf, v_buf, acc_ref, m_ref, l_ref, sems = rest
     b = pl.program_id(0)
     h = pl.program_id(1)
     qb = pl.program_id(2)
@@ -295,33 +371,35 @@ def _paged_chunk_kernel(tables_ref, history_ref, chunk_ref, q_ref,
     kv_limit = hist + jnp.minimum((qb + 1) * block_q, clen)
     n_chunks = jnp.maximum(pl.cdiv(kv_limit, chunk), 1)
 
-    def start_chunk(ci, slot):
+    def page_dmas(ci, slot):
+        dmas = []
         for j in range(pages_per_chunk):
             page_idx = jnp.minimum(ci * pages_per_chunk + j,
                                    max_pages - 1)
             pid = jnp.minimum(tables_ref[b, page_idx], n_pages - 1)
-            pltpu.make_async_copy(
-                k_hbm.at[h, pid],
-                k_buf.at[slot, pl.ds(j * page, page), :],
-                sems.at[slot, 0, j]).start()
-            pltpu.make_async_copy(
-                v_hbm.at[h, pid],
-                v_buf.at[slot, pl.ds(j * page, page), :],
-                sems.at[slot, 1, j]).start()
+            dst = pl.ds(j * page, page)
+            dmas.append(pltpu.make_async_copy(
+                k_hbm.at[h, pid], k_buf.at[slot, dst, :],
+                sems.at[slot, 0, j]))
+            dmas.append(pltpu.make_async_copy(
+                v_hbm.at[h, pid], v_buf.at[slot, dst, :],
+                sems.at[slot, 1, j]))
+            if quantized:
+                dmas.append(pltpu.make_async_copy(
+                    ks_hbm.at[h, pid], ks_buf.at[slot, dst, :],
+                    sems.at[slot, 2, j]))
+                dmas.append(pltpu.make_async_copy(
+                    vs_hbm.at[h, pid], vs_buf.at[slot, dst, :],
+                    sems.at[slot, 3, j]))
+        return dmas
+
+    def start_chunk(ci, slot):
+        for dma in page_dmas(ci, slot):
+            dma.start()
 
     def wait_chunk(ci, slot):
-        for j in range(pages_per_chunk):
-            page_idx = jnp.minimum(ci * pages_per_chunk + j,
-                                   max_pages - 1)
-            pid = jnp.minimum(tables_ref[b, page_idx], n_pages - 1)
-            pltpu.make_async_copy(
-                k_hbm.at[h, pid],
-                k_buf.at[slot, pl.ds(j * page, page), :],
-                sems.at[slot, 0, j]).wait()
-            pltpu.make_async_copy(
-                v_hbm.at[h, pid],
-                v_buf.at[slot, pl.ds(j * page, page), :],
-                sems.at[slot, 1, j]).wait()
+        for dma in page_dmas(ci, slot):
+            dma.wait()
 
     m_ref[:] = jnp.full_like(m_ref, NEG_INF)
     l_ref[:] = jnp.zeros_like(l_ref)
@@ -344,6 +422,8 @@ def _paged_chunk_kernel(tables_ref, history_ref, chunk_ref, q_ref,
 
         wait_chunk(ci, slot)
         k = k_buf[slot].astype(jnp.float32)             # [chunk, hd]
+        if quantized:
+            k = k * ks_buf[slot]        # in-register dequant, [chunk, 1]
         s = jax.lax.dot_general(
             qf, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)         # [BQ*G, chunk]
@@ -362,9 +442,11 @@ def _paged_chunk_kernel(tables_ref, history_ref, chunk_ref, q_ref,
         # NEG_INF and exp(s - m_new) would be 1
         p = jnp.where(visible, jnp.exp(s - m_new), 0.0)
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_buf[slot].astype(jnp.float32)             # [chunk, hd]
+        if quantized:
+            v = v * vs_buf[slot]
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v_buf[slot].astype(jnp.float32),
-            (((1,), (0,)), ((), ())),
+            p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)         # [BQ*G, hd]
         m_ref[:] = m_new
         return 0
@@ -384,8 +466,8 @@ def _pick_block_q(sq: int) -> int:
     return 1
 
 
-def paged_chunk_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
-                                 v_pool: jnp.ndarray, tables: jnp.ndarray,
+def paged_chunk_attention_pallas(q: jnp.ndarray, k_pool,
+                                 v_pool, tables: jnp.ndarray,
                                  history_lens: jnp.ndarray,
                                  chunk_lens: jnp.ndarray, *,
                                  scale: float | None = None,
@@ -394,11 +476,15 @@ def paged_chunk_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
     """Ragged chunk attention. q [B, Sq, Hq, hd] holds Sq new positions
     per slot, already written into the pool at rows
     ``[history_lens, history_lens + chunk_lens)``; pools
-    [Hkv, Np, pg, hd]. Query row i of slot b attends causally to pool
+    [Hkv, Np, pg, hd] (plain) or the ``{"q", "s"}`` quantized pytree.
+    Query row i of slot b attends causally to pool
     rows <= history_lens[b] + i. Rows past ``chunk_lens[b]`` are
     padding: their output is finite garbage the caller discards."""
+    k_codes, k_scales = _split_pool(k_pool)
+    v_codes, v_scales = _split_pool(v_pool)
+    quantized = k_scales is not None
     b, sq, hq, hd = q.shape
-    hkv, n_pages, page, _ = k_pool.shape
+    hkv, n_pages, page, _ = k_codes.shape
     _, max_pages = tables.shape
     group = hq // hkv
     scale = scale if scale is not None else hd ** -0.5
@@ -406,7 +492,7 @@ def paged_chunk_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
         block_q = _pick_block_q(sq)
     if sq % block_q != 0:
         raise ValueError(f"block_q {block_q} must divide Sq {sq}")
-    _check_page_alignment(page, interpret)
+    _check_page_alignment(page, interpret, quantized)
 
     pages_per_chunk = max(1, min(max_pages, -(-128 // page)))
     chunk = pages_per_chunk * page
@@ -427,8 +513,12 @@ def paged_chunk_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
     kernel = functools.partial(
         _paged_chunk_kernel, page=page, pages_per_chunk=pages_per_chunk,
         max_pages=max_pages, n_pages=n_pages, scale=scale,
-        block_q=block_q, group=group_p)
+        block_q=block_q, group=group_p, quantized=quantized)
     rows = block_q * group_p
+    scale_specs = [pl.BlockSpec(memory_space=pl.ANY)] * 2 \
+        if quantized else []
+    scale_bufs = [pltpu.VMEM((2, chunk, 1), jnp.float32)] * 2 \
+        if quantized else []
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(b, hkv, sq // block_q),
@@ -438,19 +528,26 @@ def paged_chunk_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),      # k pool stays in HBM
             pl.BlockSpec(memory_space=pl.ANY),      # v pool stays in HBM
+            *scale_specs,
         ],
         out_specs=pl.BlockSpec((1, 1, rows, hd),
                                lambda i, j, k, *_: (i, j, k, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((2, chunk, hd), k_pool.dtype),
-            pltpu.VMEM((2, chunk, hd), v_pool.dtype),
+            pltpu.VMEM((2, chunk, hd), k_codes.dtype),
+            pltpu.VMEM((2, chunk, hd), v_codes.dtype),
+            *scale_bufs,
             pltpu.VMEM((rows, hd), jnp.float32),
             pltpu.VMEM((rows, 1), jnp.float32),
             pltpu.VMEM((rows, 1), jnp.float32),
-            pltpu.SemaphoreType.DMA((2, 2, pages_per_chunk)),
+            pltpu.SemaphoreType.DMA((2, 4 if quantized else 2,
+                                     pages_per_chunk)),
         ],
     )
+    args = [tables.astype(jnp.int32), history_lens.astype(jnp.int32),
+            chunk_lens.astype(jnp.int32), q4, k_codes, v_codes]
+    if quantized:
+        args += [k_scales, v_scales]
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((b, hkv, sq * group_p, hd),
@@ -459,15 +556,14 @@ def paged_chunk_attention_pallas(q: jnp.ndarray, k_pool: jnp.ndarray,
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel")),
         interpret=interpret,
-    )(tables.astype(jnp.int32), history_lens.astype(jnp.int32),
-      chunk_lens.astype(jnp.int32), q4, k_pool, v_pool)
+    )(*args)
     return out.reshape(b, hkv, sq, group_p, hd) \
         .transpose(0, 2, 1, 3, 4)[:, :, :, :group] \
         .reshape(b, sq, hq, hd)
 
 
-def paged_chunk_attention_xla(q: jnp.ndarray, k_pool: jnp.ndarray,
-                              v_pool: jnp.ndarray, tables: jnp.ndarray,
+def paged_chunk_attention_xla(q: jnp.ndarray, k_pool,
+                              v_pool, tables: jnp.ndarray,
                               history_lens: jnp.ndarray,
                               chunk_lens: jnp.ndarray, *,
                               scale: float | None = None) -> jnp.ndarray:
@@ -475,21 +571,16 @@ def paged_chunk_attention_xla(q: jnp.ndarray, k_pool: jnp.ndarray,
     attention offset by the history. Materialises [B, Mp*pg, Hkv, hd]
     per call — the traffic the kernel exists to avoid."""
     from .attention import xla_attention
-    hkv, n_pages, page, hd = k_pool.shape
-    b, max_pages = tables.shape
-    safe = jnp.minimum(tables, n_pages - 1)
-    k_view = k_pool[:, safe].transpose(1, 2, 3, 0, 4).reshape(
-        b, max_pages * page, hkv, hd)
-    v_view = v_pool[:, safe].transpose(1, 2, 3, 0, 4).reshape(
-        b, max_pages * page, hkv, hd)
+    k_view = _slot_view(k_pool, tables)
+    v_view = _slot_view(v_pool, tables)
     return xla_attention(q, k_view, v_view, causal=True,
                          q_offset=history_lens,
                          kv_lengths=history_lens + chunk_lens,
                          scale=scale)
 
 
-def paged_chunk_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
-                          v_pool: jnp.ndarray, tables: jnp.ndarray,
+def paged_chunk_attention(q: jnp.ndarray, k_pool,
+                          v_pool, tables: jnp.ndarray,
                           history_lens: jnp.ndarray,
                           chunk_lens: jnp.ndarray, *,
                           scale: float | None = None,
@@ -508,8 +599,8 @@ def paged_chunk_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                                      history_lens, chunk_lens, scale=scale)
 
 
-def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
-                           v_pool: jnp.ndarray, tables: jnp.ndarray,
+def paged_decode_attention(q: jnp.ndarray, k_pool,
+                           v_pool, tables: jnp.ndarray,
                            lengths: jnp.ndarray, *,
                            scale: float | None = None,
                            implementation: str = "auto") -> jnp.ndarray:

@@ -31,19 +31,141 @@ Everything here is a pure jittable function on static shapes:
 
 Free-list bookkeeping is host-side (``serving/engine.py``): the device
 never sees an allocator, only tables.
+
+Quantized pools
+---------------
+``kv_dtype="int8"`` swaps the plain ``[L, Hkv, Np, pg, hd]`` array for
+a two-leaf pytree ``{"q": int8 [L, Hkv, Np, pg, hd],
+"s": f32 [L, Hkv, Np, pg, 1]}`` — narrow codes plus one f32 scale per
+written ROW (same ``amax / 127`` contract as
+:func:`gofr_tpu.ops.quant.quantize_int8` with ``axis=-1``). Per-row
+(not per-page-scalar) granularity is load-bearing: decode appends one
+row to a partially filled page, and a page-wide amax recomputation
+would silently re-quantize — and degrade — rows written earlier. The
+trailing singleton keeps the scale slice a 2-D ``[page, 1]`` block so
+the ragged kernels can DMA it exactly like the page itself.
+
+Every scatter quantizes ON WRITE inside the same jitted graph (the
+engine's hot closures never dequantize host-side or ``.astype`` the
+pool — ``gofrlint``'s kv-quant-boundary rule pins this), and
+:func:`gather_view` dequantizes for the view fallback. bf16 pools stay
+plain arrays so the default path compiles the exact seed graph.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
+#: a pool is either a plain array or this two-leaf quantized pytree
+QUANT_KEYS = ("q", "s")
 
-def gather_view(pool: jnp.ndarray, tables: jnp.ndarray) -> jnp.ndarray:
+
+def is_quantized_pool(pool) -> bool:
+    """True for the ``{"q": int8, "s": f32}`` quantized pool pytree."""
+    return isinstance(pool, dict)
+
+
+def quantize_rows(rows: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Rows [..., d] -> (int8 codes [..., d], f32 scales [..., 1]).
+
+    Same contract as ``quantize_int8(w, axis=-1)``: symmetric,
+    ``scale = max(amax, 1e-8) / 127``, codes clipped to ±127. Zero rows
+    quantize to all-zero codes (scale floor), so fresh pool pages
+    dequantize to exact zeros.
+    """
+    rf = rows.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(rf), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(rf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_rows(q: jnp.ndarray, s: jnp.ndarray,
+                    dtype=jnp.float32) -> jnp.ndarray:
+    """Codes [..., d] * scales [..., 1] -> values [..., d] in ``dtype``."""
+    return (q.astype(jnp.float32) * s).astype(dtype)
+
+
+def quantize_pool(pool: jnp.ndarray) -> dict:
+    """Re-lay a plain head-major pool [L, H, Np, pg, d] as the
+    quantized pytree (per-row scales). Used at allocation time and by
+    tests; steady-state writes go through the scatters."""
+    q, s = quantize_rows(pool)
+    return {"q": q, "s": s}
+
+
+def pool_shape(pool) -> tuple:
+    """[L, H, Np, pg, d] logical shape for either pool representation."""
+    return pool["q"].shape if is_quantized_pool(pool) else pool.shape
+
+
+def pool_row_bytes(pool) -> int:
+    """HBM bytes per KV ROW (one token, all layers/heads, K or V side
+    only) — includes the per-row scale overhead for quantized pools."""
+    if is_quantized_pool(pool):
+        l, h, _, _, d = pool["q"].shape
+        return l * h * (d * pool["q"].dtype.itemsize
+                        + pool["s"].dtype.itemsize)
+    l, h, _, _, d = pool.shape
+    return l * h * d * pool.dtype.itemsize
+
+
+def pool_layer(pool, li):
+    """Layer ``li``'s [H, Np, pg, d] slice (pytree-aware) — what the
+    ragged attention dispatchers take as ``k_pool`` / ``v_pool``."""
+    if is_quantized_pool(pool):
+        return {k: jax.lax.dynamic_index_in_dim(pool[k], li, 0,
+                                                keepdims=False)
+                for k in QUANT_KEYS}
+    return jax.lax.dynamic_index_in_dim(pool, li, 0, keepdims=False)
+
+
+def pool_write(pool, li, pids, offs, rows):
+    """Write ``rows`` into layer ``li`` at (page, offset) coordinates —
+    the single-layer scatter the model families use inside their layer
+    scan. ``pids``/``offs`` are the advanced-index arrays ([B] decode,
+    [B, S] chunk); ``rows`` matches the advanced-index result shape
+    ([B, H, d] / [B, S, H, d]). Quantizes on write for quantized pools;
+    plain pools absorb the dtype cast here so callers never touch the
+    pool dtype."""
+    if is_quantized_pool(pool):
+        q, s = quantize_rows(rows)
+        return {"q": pool["q"].at[li, :, pids, offs].set(q, mode="drop"),
+                "s": pool["s"].at[li, :, pids, offs].set(s, mode="drop")}
+    return pool.at[li, :, pids, offs].set(rows.astype(pool.dtype),
+                                          mode="drop")
+
+
+def _pool_set(pool, pids, offs, rows):
+    """All-layer scatter: rows [L, H, P, S, d] at pids/offs [P, S]."""
+    if is_quantized_pool(pool):
+        q, s = quantize_rows(rows)
+        return {"q": pool["q"].at[:, :, pids, offs].set(q, mode="drop"),
+                "s": pool["s"].at[:, :, pids, offs].set(s, mode="drop")}
+    return pool.at[:, :, pids, offs].set(rows.astype(pool.dtype),
+                                         mode="drop")
+
+
+def gather_view(pool, tables: jnp.ndarray,
+                dtype=None) -> jnp.ndarray:
     """Pool [L, H, Np, pg, d] + tables [B, Mp] -> view [L, B, Mp*pg, H, d].
 
     Out-of-range table entries (unallocated = Np) clamp to the last
     page on gather; those rows are masked by the caller's kv_lengths.
+    Quantized pools dequantize here (``dtype`` picks the view dtype,
+    default bf16); for plain pools ``dtype`` is ignored — the view is
+    the pool dtype, exactly as before.
     """
+    if is_quantized_pool(pool):
+        qv = _gather_raw(pool["q"], tables)     # [L, B, S, H, d] int8
+        sv = _gather_raw(pool["s"], tables)     # [L, B, S, H, 1] f32
+        return dequantize_rows(
+            qv, sv, jnp.bfloat16 if dtype is None else dtype)
+    return _gather_raw(pool, tables)
+
+
+def _gather_raw(pool: jnp.ndarray, tables: jnp.ndarray) -> jnp.ndarray:
     l, h, np_, pg, d = pool.shape
     b, mp = tables.shape
     view = pool[:, :, tables]                   # [L, H, B, Mp, pg, d]
@@ -51,24 +173,24 @@ def gather_view(pool: jnp.ndarray, tables: jnp.ndarray) -> jnp.ndarray:
     return view.reshape(l, b, mp * pg, h, d)
 
 
-def scatter_prefill(pool: jnp.ndarray, tables: jnp.ndarray,
-                    k_slab: jnp.ndarray) -> jnp.ndarray:
+def scatter_prefill(pool, tables: jnp.ndarray,
+                    k_slab: jnp.ndarray):
     """Write a prompt K (or V) slab [L, P, S, H, d] into the pool via
     per-row tables [P, Mp]. Positions whose table entry is the OOB page
     id are dropped (padding beyond each row's allocation, dummy rows).
     """
-    pg = pool.shape[3]
+    pg = pool_shape(pool)[3]
     s = k_slab.shape[2]
     pos = jnp.arange(s)
     pids = jnp.take(tables, pos // pg, axis=1)          # [P, S]
     offs = jnp.broadcast_to(pos % pg, pids.shape)       # [P, S]
     slab = k_slab.transpose(0, 3, 1, 2, 4)              # [L, H, P, S, d]
-    return pool.at[:, :, pids, offs].set(slab, mode="drop")
+    return _pool_set(pool, pids, offs, slab)
 
 
-def scatter_chunk(pool: jnp.ndarray, tables: jnp.ndarray,
+def scatter_chunk(pool, tables: jnp.ndarray,
                   slab: jnp.ndarray, offsets: jnp.ndarray,
-                  chunk_lens: jnp.ndarray) -> jnp.ndarray:
+                  chunk_lens: jnp.ndarray):
     """Write a chunk slab [L, P, S, H, d] whose row b covers logical
     positions ``[offsets[b], offsets[b] + chunk_lens[b])`` into the
     pool — touching only the pages the chunk spans. ``scatter_prefill``
@@ -78,8 +200,7 @@ def scatter_chunk(pool: jnp.ndarray, tables: jnp.ndarray,
     the OOB page id and drop, so a 5-token suffix in a 512-wide bucket
     writes one page, not the slot's whole allocation.
     """
-    pg = pool.shape[3]
-    n_pages = pool.shape[2]
+    n_pages, pg = pool_shape(pool)[2:4]
     mp = tables.shape[1]
     s = slab.shape[2]
     pos = offsets[:, None] + jnp.arange(s)[None, :]             # [P, S]
@@ -89,18 +210,17 @@ def scatter_chunk(pool: jnp.ndarray, tables: jnp.ndarray,
     pids = jnp.where(valid & (pos < mp * pg), pids, n_pages)
     offs = pos % pg
     rows = slab.transpose(0, 3, 1, 2, 4)                # [L, H, P, S, d]
-    return pool.at[:, :, pids, offs].set(rows, mode="drop")
+    return _pool_set(pool, pids, offs, rows)
 
 
-def scatter_decode(pool: jnp.ndarray, tables: jnp.ndarray,
+def scatter_decode(pool, tables: jnp.ndarray,
                    view: jnp.ndarray, lengths: jnp.ndarray,
-                   k_steps: int) -> jnp.ndarray:
+                   k_steps: int):
     """Copy the ``k_steps`` rows a decode pass appended to ``view``
     (at logical positions lengths .. lengths+K-1 per slot) back into
     the pool. view [L, B, S, H, d], tables [B, Mp], lengths [B].
     """
-    pg = pool.shape[3]
-    n_pages = pool.shape[2]
+    n_pages, pg = pool_shape(pool)[2:4]
     s = view.shape[2]
     positions = lengths[:, None] + jnp.arange(k_steps)[None, :]   # [B, K]
     clamped = jnp.minimum(positions, s - 1)
@@ -112,7 +232,7 @@ def scatter_decode(pool: jnp.ndarray, tables: jnp.ndarray,
     pids = jnp.where(positions < s, pids, n_pages)
     offs = clamped % pg
     rows = new_rows.transpose(0, 3, 1, 2, 4)            # [L, H, B, K, d]
-    return pool.at[:, :, pids, offs].set(rows, mode="drop")
+    return _pool_set(pool, pids, offs, rows)
 
 
 def pool_from_cache_shape(k_cache: jnp.ndarray) -> jnp.ndarray:

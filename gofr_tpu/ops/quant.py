@@ -119,9 +119,13 @@ _INT4_DTYPES = tuple(jnp.dtype(d) for d in (jnp.int4, jnp.uint4))
 
 
 def quantized_bytes(tree: Any) -> int:
-    """Parameter bytes as stored on TPU (int8 leaves count 1 byte,
-    int4/uint4 half a byte, plus scales). The 0.5 B/param figure is
-    the INTENDED packed size — XLA packs two 4-bit values per byte on
+    """Bytes a pytree occupies as stored on TPU (int8 leaves count
+    1 byte, int4/uint4 half a byte, plus scales). Works on any tree:
+    quantized weight dicts AND the paged KV pool's ``{"q", "s"}``
+    pytree (``ops/paged_kv.py``) — the per-row scale leaves are just
+    more leaves, so the engine's ``kv_bytes`` accounting is one call
+    over ``(k_cache, v_cache)``. The 0.5 B/param figure is the
+    INTENDED packed size — XLA packs two 4-bit values per byte on
     TPU — not a measured allocation; a backend that keeps int4
     unpacked (CPU does) actually spends a full byte per value."""
     import jax

@@ -254,6 +254,21 @@ class EngineConfig:
     #: capacity (max_batch x ceil(max_seq/page_size)). Smaller values
     #: overcommit: more concurrent short requests in the same HBM.
     kv_pages: int | None = None
+    #: KV page storage dtype (paged layout only). "bf16" (default)
+    #: stores pages in the model dtype — bit-identical to the classic
+    #: pool. "int8" stores narrow codes plus one f32 scale per row
+    #: (ops/paged_kv.py quantized pool): pages quantize on write
+    #: inside the jitted scatters and the ragged kernels dequantize
+    #: in-register after each per-page DMA, so per-row HBM cost falls
+    #: from 2·hd to hd+4 bytes — at the same byte budget the pool
+    #: holds ~2x the pages (1.88x at hd=64, 1.94x at hd=128).
+    kv_dtype: str = "bf16"
+    #: explicit KV pool HBM budget in bytes (paged layout only; K and
+    #: V together). None derives the budget from ``kv_pages`` (or the
+    #: full contiguous capacity) at the NATIVE page cost, so switching
+    #: ``kv_dtype`` to int8 under the same budget grows the page count
+    #: instead of shrinking the footprint — capacity is the point.
+    kv_pool_bytes: int | None = None
     #: paged layout only: retain retired requests' page-aligned prompt
     #: prefixes and share them with later requests bearing the same
     #: prefix (the common system prompt) — the suffix prefills through
@@ -471,6 +486,23 @@ class Engine:
             raise ValueError(
                 f"paged_attention must be one of auto/kernel/interpret/"
                 f"xla/view, got {cfg.paged_attention!r}")
+        if cfg.kv_dtype not in ("bf16", "int8"):
+            raise ValueError(f"kv_dtype must be 'bf16' or 'int8', "
+                             f"got {cfg.kv_dtype!r}")
+        if cfg.kv_dtype != "bf16" and cfg.kv_layout != "paged":
+            raise ValueError("kv_dtype='int8' requires kv_layout="
+                             "'paged' (the quantized pool is a page "
+                             "pool; the slot layout has no pages)")
+        if cfg.kv_pool_bytes is not None and cfg.kv_layout != "paged":
+            raise ValueError("kv_pool_bytes sizes the paged pool; "
+                             "set kv_layout='paged'")
+        #: dtype the dequantized view/model side of a quantized pool
+        #: uses (set by _alloc_pool from the probe allocation); None
+        #: until a pool exists — plain pools ignore it entirely
+        self._kv_view_dtype = None
+        #: allocated KV bytes (both caches, scale leaves included) —
+        #: quant.quantized_bytes over the cache pytree, set post-alloc
+        self._kv_bytes_total = 0
 
         # persistent XLA compilation cache BEFORE any graph compiles:
         # warmup's compile wall amortizes across processes (bench
@@ -610,8 +642,10 @@ class Engine:
                         # the model family never sees pages
                         toks_in = jnp.where(use_prev, prev, tokens)
                         tb = tables if mp_w is None else tables[:, :mp_w]
-                        k_view = gather_view(k_pool, tb)
-                        v_view = gather_view(v_pool, tb)
+                        k_view = gather_view(k_pool, tb,
+                                             dtype=self._kv_view_dtype)
+                        v_view = gather_view(v_pool, tb,
+                                             dtype=self._kv_view_dtype)
 
                         def step_fn(toks, kc, vc, lens):
                             return decode_fn(params, toks, kc, vc, lens)
@@ -747,8 +781,14 @@ class Engine:
         if cfg.kv_layout == "paged":
             pg = max(1, int(cfg.page_size))
             self._pages_per_slot = -(-cfg.max_seq // pg)        # ceil
-            self._n_pages = (cfg.kv_pages if cfg.kv_pages is not None
-                             else cfg.max_batch * self._pages_per_slot)
+            base_pages = (cfg.kv_pages if cfg.kv_pages is not None
+                          else cfg.max_batch * self._pages_per_slot)
+            # pools are sized in BYTES, not rows: the page count is
+            # budget // per-page-cost for the configured kv_dtype, so
+            # an int8 pool at the same budget holds ~2x the pages.
+            # The bf16 default without an explicit budget resolves to
+            # exactly base_pages (no probe, no arithmetic drift).
+            self._n_pages = self._sized_pool_pages(pg, base_pages)
             self.k_cache, self.v_cache = self._alloc_pool(pg)
             self._free_pages = list(range(self._n_pages))
             #: per-slot ordered page ids; OOB id ``n_pages`` = unallocated
@@ -776,6 +816,12 @@ class Engine:
             self.k_cache, self.v_cache = make_cache(cfg.max_batch,
                                                     cfg.max_seq)
             self._prefix_enabled = False  # sharing needs page tables
+        # allocated KV footprint (K + V, scale leaves included):
+        # quantized_bytes walks the pytree so the quantized pool's q/s
+        # split needs no special casing here
+        from ..ops.quant import quantized_bytes
+        self._kv_bytes_total = int(quantized_bytes(
+            (self.k_cache, self.v_cache)))
         self.lengths = np.zeros(cfg.max_batch, np.int32)       # kv length per slot
         self.active: list[GenRequest | None] = [None] * cfg.max_batch
         # already-admitted work bounced back (preemption, slot races,
@@ -996,7 +1042,7 @@ class Engine:
         self._tables_dirty = True
         self._decode_busy_until = 0.0
         self._prefill_busy_until = 0.0
-        lost = self.k_cache.is_deleted() or self.v_cache.is_deleted()
+        lost = self._kv_lost()
         if cfg.kv_layout == "paged":
             if lost:
                 self.k_cache, self.v_cache = self._alloc_pool(
@@ -1088,6 +1134,9 @@ class Engine:
             ("app_engine_prefix_pages_watermark",
              "high-water mark of page references pinned by the prefix "
              "cache"),
+            ("app_engine_kv_bytes_watermark",
+             "high-water mark of KV-pool HBM bytes held by in-use "
+             "pages/rows (scale leaves included for int8 pools)"),
             ("app_engine_host_rss_bytes_watermark",
              "host process RSS high-water mark (ru_maxrss)"),
         ):
@@ -1245,7 +1294,7 @@ class Engine:
         buckets = {self._bucket_for(int(n)) for n in prompt_lens}
         for bucket in sorted(buckets):
             for g in self._group_sizes():
-                self.sentinel.observe(("prefill", bucket, g))
+                self.sentinel.observe(self._sig("prefill", bucket, g))
                 if paged:  # all-OOB tables: every write drops
                     slots = jnp.full((g, self._pages_per_slot),
                                      self._n_pages, jnp.int32)
@@ -1264,7 +1313,7 @@ class Engine:
             tables = (jnp.full((b, self._pages_per_slot), self._n_pages,
                                jnp.int32),) if paged else ()
             for w in (0, *self._decode_windows):
-                self.sentinel.observe(("decode", w))
+                self.sentinel.observe(self._sig("decode", w))
             variants = [self._decode] + [
                 self._decode_by_window[w] for w in self._decode_windows]
             for fn in variants:
@@ -1316,7 +1365,8 @@ class Engine:
                     if cw is not None and width > cw:
                         continue  # the dispatcher never picks cw then
                     for g in sorted({1, P}):
-                        self.sentinel.observe(("chunk", width, g, cw))
+                        self.sentinel.observe(
+                            self._sig("chunk", width, g, cw))
                         if paged:
                             slot_arg = jnp.full(
                                 (g, self._pages_per_slot),
@@ -1337,7 +1387,8 @@ class Engine:
         if self._spec_enabled:
             # the verify graph's width is static and its lazy first
             # compile on the serving path is expected, not a regression
-            self.sentinel.observe(("spec_verify", cfg.spec_draft + 1))
+            self.sentinel.observe(
+                self._sig("spec_verify", cfg.spec_draft + 1))
         self.sentinel.seal()
 
     def _clamp_prompt(self, tokens: list[int], max_new: int) -> list[int]:
@@ -1507,11 +1558,12 @@ class Engine:
                     # scatter_chunk (offset 0, per-row prompt length)
                     # writes only the pages each prompt spans — pad
                     # rows past kv_len drop instead of round-tripping
+                    # the scatter owns the pool representation: plain
+                    # pools cast internally, quantized pools quantize
+                    # on write (no .astype on the pool here)
                     zeros = jnp.zeros_like(kv_len)
-                    kc = scatter_chunk(kc, slots, k.astype(kc.dtype),
-                                       zeros, kv_len)
-                    vc = scatter_chunk(vc, slots, v.astype(vc.dtype),
-                                       zeros, kv_len)
+                    kc = scatter_chunk(kc, slots, k, zeros, kv_len)
+                    vc = scatter_chunk(vc, slots, v, zeros, kv_len)
                 else:
                     s = k.shape[2]
                     kc = kc.at[:, slots, :s].set(k.astype(kc.dtype),
@@ -1577,19 +1629,20 @@ class Engine:
                     width = tokens.shape[1]
                     tables = (tables if mp_w is None
                               else tables[:, :mp_w])
-                    k_view = gather_view(kp, tables)
-                    v_view = gather_view(vp, tables)
+                    k_view = gather_view(kp, tables,
+                                         dtype=self._kv_view_dtype)
+                    v_view = gather_view(vp, tables,
+                                         dtype=self._kv_view_dtype)
                     logits, k_view, v_view = chunk_fn(
                         params, tokens, k_view, v_view, offsets,
                         chunk_lens)
                     # write back exactly each row's chunk range; rows
                     # beyond chunk_len round-trip their gathered values
-                    # and unallocated (dummy) pages drop
-                    kp = scatter_decode(kp, tables,
-                                        k_view.astype(kp.dtype),
+                    # and unallocated (dummy) pages drop (the scatter
+                    # owns the pool dtype/quantization)
+                    kp = scatter_decode(kp, tables, k_view,
                                         offsets, width)
-                    vp = scatter_decode(vp, tables,
-                                        v_view.astype(vp.dtype),
+                    vp = scatter_decode(vp, tables, v_view,
                                         offsets, width)
                     key = jax.random.fold_in(rng_key, step)
                     toks = _sample_batch(logits, key, temps,
@@ -2086,12 +2139,11 @@ class Engine:
             if self.metrics is not None:
                 self.metrics.increment_counter("app_engine_requeues")
 
-    def _alloc_pool(self, page: int):
-        """Allocate the head-major paged pool [L, Hkv, Np, pg, hd]
-        (ops/paged_kv.py: the kernel's per-(head, page) DMA must slice
-        only untiled leading dims). Cache constructors that know the
-        layout build it directly (``head_major=True``); older ones
-        return [L, Np, pg, Hkv, hd] and pay a one-off transpose."""
+    def _alloc_head_major(self, n_pages: int, page: int):
+        """One head-major pool pair [L, Hkv, Np, pg, hd] in the MODEL
+        dtype. Cache constructors that know the layout build it
+        directly (``head_major=True``); older ones return
+        [L, Np, pg, Hkv, hd] and pay a one-off transpose."""
         import inspect
 
         from ..ops.paged_kv import pool_from_cache_shape
@@ -2104,9 +2156,55 @@ class Engine:
             # signature-probed, NOT try/except TypeError: an error
             # raised INSIDE an aware constructor must surface as
             # itself, not silently re-run the legacy path
-            return self._make_cache(self._n_pages, page, head_major=True)
-        kc, vc = self._make_cache(self._n_pages, page)
+            return self._make_cache(n_pages, page, head_major=True)
+        kc, vc = self._make_cache(n_pages, page)
         return pool_from_cache_shape(kc), pool_from_cache_shape(vc)
+
+    def _alloc_pool(self, page: int):
+        """Allocate the paged pool (ops/paged_kv.py: the kernel's
+        per-(head, page) DMA must slice only untiled leading dims).
+        ``kv_dtype="int8"`` re-lays the zero allocation as the
+        quantized ``{"q", "s"}`` pytree — every later write quantizes
+        inside the jitted scatters, so this is the only place the
+        representation is chosen."""
+        kc, vc = self._alloc_head_major(self._n_pages, page)
+        # the model dtype the view fallback dequantizes back to
+        leaf = jax.tree_util.tree_leaves(kc)[0]
+        self._kv_view_dtype = leaf.dtype
+        if self.config.kv_dtype == "int8":
+            from ..ops.paged_kv import quantize_pool
+            kc, vc = quantize_pool(kc), quantize_pool(vc)
+        return kc, vc
+
+    def _sized_pool_pages(self, page: int, base_pages: int) -> int:
+        """Resolve the pool's page count from its BYTE budget. The
+        budget is ``kv_pool_bytes`` when set, else ``base_pages`` at
+        the native per-page cost — so flipping ``kv_dtype`` to int8
+        keeps the footprint and roughly doubles the pages. The bf16
+        default with no explicit budget short-circuits to
+        ``base_pages`` exactly (no probe allocation, no rounding)."""
+        cfg = self.config
+        if cfg.kv_dtype == "bf16" and cfg.kv_pool_bytes is None:
+            return max(1, int(base_pages))
+        from ..ops.paged_kv import pool_row_bytes, pool_shape
+        probe_k, _ = self._alloc_head_major(1, page)
+        pg = pool_shape(probe_k)[3]
+        native_page = 2 * pg * pool_row_bytes(probe_k)   # K + V
+        if cfg.kv_dtype == "int8":
+            from ..ops.paged_kv import quantize_pool
+            per_page = 2 * pg * pool_row_bytes(quantize_pool(probe_k))
+        else:
+            per_page = native_page
+        budget = (cfg.kv_pool_bytes if cfg.kv_pool_bytes is not None
+                  else base_pages * native_page)
+        return max(1, int(budget) // per_page)
+
+    def _kv_lost(self) -> bool:
+        """True when a failed donated dispatch consumed either cache —
+        pytree-aware (a quantized pool is multiple leaves)."""
+        return any(leaf.is_deleted() for leaf in
+                   jax.tree_util.tree_leaves((self.k_cache,
+                                              self.v_cache)))
 
     @hot_path_boundary(
         "device-loss recovery path: the engine is already off the fast path when this runs")
@@ -2115,7 +2213,7 @@ class Engine:
         so every active slot's KV went with them — fail those streams
         honestly and stand up fresh caches so the engine keeps serving
         new requests."""
-        if not (self.k_cache.is_deleted() or self.v_cache.is_deleted()):
+        if not self._kv_lost():
             return
         cfg = self.config
         for i, other in enumerate(self.active):
@@ -2141,6 +2239,15 @@ class Engine:
             self.k_cache, self.v_cache = self._make_cache(
                 cfg.max_batch, cfg.max_seq)
 
+    def _sig(self, *parts: Any) -> tuple:
+        """Sentinel shape signature for a dispatch site. A non-default
+        ``kv_dtype`` changes every compiled graph on the paged path
+        (quantized pools are a different pytree), so it is folded into
+        the signature — bf16 signatures stay seed-identical."""
+        if self.config.kv_dtype != "bf16":
+            return (*parts, self.config.kv_dtype)
+        return parts
+
     @hot_path_boundary(
         "O(1) host set probe per dispatch; the metric/log fire only on an anomalous post-warmup recompile")
     def _note_dispatch_shape(self, *sig: Any) -> None:
@@ -2148,6 +2255,7 @@ class Engine:
         novel post-warmup shape signature means XLA is lowering a new
         graph on the serving path — count it and WARN once with the
         offending shape (O(1) host set lookup otherwise)."""
+        sig = self._sig(*sig)
         if not self.sentinel.dispatch(sig):
             return
         self.stats["recompiles"] += 1
@@ -2553,8 +2661,9 @@ class Engine:
         verify dispatch, the view path leaves it flat."""
         if self.config.kv_layout != "paged":
             return
-        l, hkv, _, pg, hd = self.k_cache.shape
-        row_bytes = l * hkv * hd * self.k_cache.dtype.itemsize
+        from ..ops.paged_kv import pool_row_bytes, pool_shape
+        pg = pool_shape(self.k_cache)[3]
+        row_bytes = pool_row_bytes(self.k_cache)
         self.stats["view_bytes_avoided"] += \
             2 * n_rows * self._pages_per_slot * pg * row_bytes
 
@@ -2965,16 +3074,16 @@ class Engine:
                           chunk_lens, step, temps, top_ps, top_ks,
                           rng_key):
                     s_width = tokens.shape[1]
-                    k_view = gather_view(kc, tables)
-                    v_view = gather_view(vc, tables)
+                    k_view = gather_view(kc, tables,
+                                         dtype=self._kv_view_dtype)
+                    v_view = gather_view(vc, tables,
+                                         dtype=self._kv_view_dtype)
                     logits, k_view, v_view = verify_fn(
                         params, tokens, k_view, v_view, offsets,
                         chunk_lens)
-                    kc = scatter_decode(kc, tables,
-                                        k_view.astype(kc.dtype),
+                    kc = scatter_decode(kc, tables, k_view,
                                         offsets, s_width)
-                    vc = scatter_decode(vc, tables,
-                                        v_view.astype(vc.dtype),
+                    vc = scatter_decode(vc, tables, v_view,
                                         offsets, s_width)
                     accepted, bonus = _accept_and_bonus(
                         logits, tokens, chunk_lens, step, temps,
@@ -3162,11 +3271,19 @@ class Engine:
         if not wm.enabled:
             return
         if self.config.kv_layout == "paged":
-            wm.update("kv_pages",
-                      float(self._n_pages - len(self._free_pages)))
+            used = self._n_pages - len(self._free_pages)
+            wm.update("kv_pages", float(used))
             wm.update("prefix_pages", float(self._cached_pages))
+            wm.update("kv_bytes",
+                      used * self._kv_bytes_total
+                      / max(1, self._n_pages))
         else:
-            wm.update("kv_rows", float(self.lengths.sum()))
+            rows = float(self.lengths.sum())
+            wm.update("kv_rows", rows)
+            wm.update("kv_bytes",
+                      rows * self._kv_bytes_total
+                      / max(1, self.config.max_batch
+                            * self.config.max_seq))
 
     def _update_watermarks(self) -> None:
         """Advance every memory high-water mark (throttled cadence):
@@ -3182,9 +3299,16 @@ class Engine:
         goodput classification, memory watermarks, recompile sentinel
         state — all host-side reads."""
         self._update_watermarks()
+        cfg = self.config
+        cap_tokens = (self._n_pages * max(1, int(cfg.page_size))
+                      if cfg.kv_layout == "paged"
+                      else cfg.max_batch * cfg.max_seq)
         return {"goodput": self.goodput.state(),
                 "watermarks": self.watermarks.state(),
-                "recompiles": self.sentinel.state()}
+                "recompiles": self.sentinel.state(),
+                "kv_bytes": self._kv_bytes_total,
+                "kv_bytes_per_token": round(
+                    self._kv_bytes_total / max(1, cap_tokens), 3)}
 
     def _update_gauges(self) -> None:
         m = self.metrics
@@ -3223,6 +3347,7 @@ class Engine:
             for mark, gauge in (
                 ("kv_pages", "app_engine_kv_pages_watermark"),
                 ("kv_rows", "app_engine_kv_rows_watermark"),
+                ("kv_bytes", "app_engine_kv_bytes_watermark"),
                 ("prefix_pages", "app_engine_prefix_pages_watermark"),
                 ("host_rss_bytes",
                  "app_engine_host_rss_bytes_watermark"),

@@ -9,6 +9,15 @@ changes. This is the serving analog of the reference's horizontal
 scale-out behind its service client (reference
 pkg/gofr/service/new.go:68); on TPU the "replicas" are mesh shards in
 a single SPMD program, coordinated by the runtime rather than HTTP.
+
+``EngineConfig.kv_dtype="int8"`` needs NO glue here: ``make_cache``
+always allocates the model-dtype pool and the engine re-lays it as
+the quantized ``{"q", "s"}`` pytree at allocation time
+(``engine._alloc_pool``). The paged model fns below take whole pools
+and route writes through ``ops.paged_kv.pool_write``, which is
+pytree-aware — so native decode, chunked prefill, prefix-cache
+reattach and speculative verify all ride the quantized layout
+unchanged.
 """
 
 from __future__ import annotations

@@ -194,6 +194,16 @@ def self_test() -> int:
         ("cached entries never gate",
          [base, dict(entry(2.0, chat_tok_per_s=1.0),
                      status="cached")], 0),
+        # kv_* capacity numbers are report-only: not in
+        # THROUGHPUT_KEYS and not *_ms, so even a halved capacity
+        # ratio or tok/s must never gate (the bench asserts the
+        # >= 1.8x capacity floor itself)
+        ("kv capacity drop reports but never gates",
+         [dict(base, metrics=dict(base["metrics"],
+                                  kv_capacity_ratio=3.0,
+                                  kv_tok_per_s_int8=5000.0)),
+          entry(2.0, kv_capacity_ratio=1.2,
+                kv_tok_per_s_int8=2000.0)], 0),
     ]
     failed = 0
     for name, entries, want in checks:

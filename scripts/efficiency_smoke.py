@@ -9,9 +9,12 @@ recompile sentinel), drives chat traffic, and asserts:
 - ``app_engine_goodput_ratio`` is scraped off /metrics and is in
   (0, 1], and the ``app_engine_waste_seconds{cause}`` counters never
   exceed the busy total they conserve against;
-- memory watermarks are present and monotone across two reads;
+- memory watermarks are present and monotone across two reads — the
+  ``kv_bytes`` watermark (``app_engine_kv_bytes_watermark``) included;
 - the recompile sentinel is sealed with zero recompiles (the smoke's
-  traffic only uses warmed shapes).
+  traffic only uses warmed shapes);
+- an int8 KV pool (``kv_dtype="int8"``) at the SAME byte budget
+  admits at least 1.8x the resident sessions of the native pool.
 
 Exits nonzero on any failure; one line per check on success.
 """
@@ -68,7 +71,30 @@ def request(port, method, path, body=None, headers=None):
         conn.close()
 
 
+def check_kv_capacity() -> None:
+    """int8 KV pages at a fixed ``kv_pool_bytes`` budget must hold
+    >= 1.8x the resident sessions of the native pool: per-row bytes
+    drop from itemsize*head_dim to head_dim+4 (codes + f32 scale),
+    and the engine sizes the pool in bytes, not rows."""
+    budget = 1 << 20
+    sess_len, page = 64, 16
+    pages_per_sess = -(-sess_len // page)
+
+    def sessions(kv_dtype: str) -> int:
+        eng = demo_llama_engine(EngineConfig(
+            max_batch=4, max_seq=128, seed=0, kv_layout="paged",
+            page_size=page, kv_dtype=kv_dtype, kv_pool_bytes=budget))
+        return eng._n_pages // pages_per_sess
+
+    native, int8 = sessions("bf16"), sessions("int8")
+    assert int8 >= 1.8 * native > 0, (native, int8)
+    print(f"ok: int8 KV pool admits {int8} resident sessions vs "
+          f"{native} native at the same {budget}-byte budget "
+          f"({int8 / native:.2f}x >= 1.8x)")
+
+
 def main() -> int:
+    check_kv_capacity()
     engine = demo_llama_engine(EngineConfig(
         max_batch=4, max_seq=128, seed=0, kv_layout="paged",
         page_size=16, prefix_cache=True, paged_attention="view"))
@@ -134,8 +160,13 @@ def main() -> int:
 
         marks1 = eff["watermarks"]
         assert marks1.get("kv_pages", {}).get("value", 0) > 0, marks1
+        assert marks1.get("kv_bytes", {}).get("value", 0) > 0, marks1
         assert marks1.get("host_rss_bytes", {}).get("value", 0) > 0, \
             marks1
+        # pool accounting rides the same payload: total HBM bytes and
+        # the per-token cost the byte-budget sizing is stated in
+        assert eff["kv_bytes"] > 0, eff
+        assert eff["kv_bytes_per_token"] > 0, eff
         sent = eff["recompiles"]
         assert sent["sealed"], sent
         assert sent["recompiles"] == 0, \
@@ -156,6 +187,7 @@ def main() -> int:
         # conserve against
         assert sum(waste.values()) <= busy + 1e-6, (waste, busy)
         for key in ("app_engine_kv_pages_watermark",
+                    "app_engine_kv_bytes_watermark",
                     "app_engine_host_rss_bytes_watermark"):
             assert parsed.get(key, 0.0) > 0.0, key
         print(f"ok: /metrics goodput ratio {ratio} in (0,1], "

@@ -113,10 +113,9 @@ def view_step(params, tokens, kp, vp, tables, offsets, chunk_lens):
     logits, k_view, v_view = llama_prefill_chunk(
         params, tokens, k_view, v_view, offsets, chunk_lens, c,
         implementation="xla")
-    kp = scatter_decode(kp, tables, k_view.astype(kp.dtype), offsets,
-                        tokens.shape[1])
-    vp = scatter_decode(vp, tables, v_view.astype(vp.dtype), offsets,
-                        tokens.shape[1])
+    # the scatter owns the pool dtype (quantize-on-write for int8)
+    kp = scatter_decode(kp, tables, k_view, offsets, tokens.shape[1])
+    vp = scatter_decode(vp, tables, v_view, offsets, tokens.shape[1])
     return logits, kp, vp
 
 

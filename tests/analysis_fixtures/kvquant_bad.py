@@ -1,0 +1,32 @@
+"""kv-quant-boundary: violations. Lines matter — test_analysis.py
+pins them."""
+import jax
+import numpy as np
+
+from gofr_tpu.ops.paged_kv import (gather_view, scatter_chunk,
+                                   scatter_decode)
+
+
+def fused_prefill(kc, vc, tables, k, v, kv_len, zeros):
+    kc = scatter_chunk(kc, tables, k.astype(kc.dtype),  # L11: boundary cast
+                       zeros, kv_len)
+    vc = scatter_chunk(vc, tables,
+                       v.astype(vc.dtype),              # L14: boundary cast
+                       zeros, kv_len)
+    return kc, vc
+
+
+def fused_chunk(kp, vp, tables, offsets, width):
+    k_view = gather_view(kp, tables)
+    kp = scatter_decode(kp, tables,
+                        k_view.astype(kp.dtype),        # L22: boundary cast
+                        offsets, width)
+    vp = vp.astype("bfloat16")                          # L24: pool cast
+    return kp, vp
+
+
+def debug_dump(pool, k_cache):
+    host = np.asarray(pool["q"])                        # L29: host readback
+    jax.device_get(k_cache)                             # L30: host readback
+    k_cache.block_until_ready()                         # L31: host sync
+    return host

@@ -1,0 +1,26 @@
+"""kv-quant-boundary: clean twin. The scatters own the pool
+representation — callers hand them raw rows (quantize-on-write for
+int8 pools, an internal cast for plain ones) and read the pool back
+only through the jitted gather."""
+from gofr_tpu.ops.paged_kv import (gather_view, scatter_chunk,
+                                   scatter_decode)
+
+
+def fused_prefill(kc, vc, tables, k, v, kv_len, zeros):
+    # no .astype at the boundary: the scatter casts/quantizes on write
+    kc = scatter_chunk(kc, tables, k, zeros, kv_len)
+    vc = scatter_chunk(vc, tables, v, zeros, kv_len)
+    return kc, vc
+
+
+def fused_chunk(kp, vp, tables, offsets, width, view_dtype):
+    # the gather dequantizes to the model dtype; rows written back raw
+    k_view = gather_view(kp, tables, dtype=view_dtype)
+    kp = scatter_decode(kp, tables, k_view, offsets, width)
+    return kp, k_view
+
+
+def sample_rows(k, kc):
+    # casting NON-pool rows elsewhere is fine — only the pool and its
+    # writer boundaries are protected
+    return k.astype("float32")

@@ -316,6 +316,34 @@ class TestRecompileHazard:
         assert violations(lint("recompile_good.py"), "recompile-hazard") == []
 
 
+# ------------------------------------------------------------- kv quant
+class TestKvQuantBoundary:
+    """Quantize-on-write contract (ops/paged_kv.py): the jitted
+    scatters own the pool representation — hot closures pass raw rows
+    and never cast or host-read the pool."""
+
+    def test_bad_fixture_flags_every_seeded_violation(self):
+        got = violations(lint("kvquant_bad.py"), "kv-quant-boundary")
+        assert {f.line for f in got} == {11, 14, 22, 24, 29, 30, 31}
+        assert any("quantizes/casts on write" in f.message for f in got)
+        assert any("host-side readback" in f.message for f in got)
+
+    def test_clean_twin_is_silent(self):
+        assert violations(lint("kvquant_good.py"),
+                          "kv-quant-boundary") == []
+
+    def test_live_serving_and_models_respect_the_boundary(self):
+        """The contract test the rule exists for: the LIVE engine/glue/
+        model hot closures quantize inside the jitted scatters — no
+        caller-side .astype at a scatter boundary, no host-side pool
+        dequant crept back in."""
+        findings, _ = run_analysis(
+            [REPO / "gofr_tpu" / "serving", REPO / "gofr_tpu" / "models",
+             REPO / "gofr_tpu" / "ops"], root=REPO)
+        assert [f for f in findings if not f.suppressed
+                and f.rule == "kv-quant-boundary"] == []
+
+
 # ---------------------------------------------------------- suppression
 class TestSuppressions:
     def test_missing_reason_is_an_error(self):

@@ -205,6 +205,130 @@ def test_page_misalignment_raises_actionable_error():
                                   interpret=True)
 
 
+# ---------------------------------------------------- quantized pools
+#
+# int8 KV pages (ops/paged_kv.py: {"q": int8, "s": f32 per-row}). The
+# kernel DMAs the codes page plus its scale column and dequantizes
+# in-register; the XLA fallback dequantizes its gathered view. Both
+# paths therefore see the SAME f32 inputs, so kernel-vs-fallback
+# parity is as tight as the unquantized case (2e-5, the repo's
+# interpret-parity idiom) — while int8-vs-f32 is bounded by the
+# quantization error itself (per element <= amax/254; observed worst
+# case ~0.018 on N(0,1) pools, asserted at 0.05 = ~3x margin).
+
+from gofr_tpu.ops.paged_attention import (paged_chunk_attention_pallas,
+                                          paged_chunk_attention_xla)
+from gofr_tpu.ops.paged_kv import quantize_pool
+
+
+def _quant_decode_case(seed, *, page, hq, hkv, lengths=(5, 17, 0)):
+    """Mid-page histories + a zero-length tail slot, quantized pools
+    alongside their f32 source."""
+    case = _random_paged_case(jax.random.key(seed), hq=hq, hkv=hkv,
+                              page=page, max_pages=8, n_pages=32,
+                              lengths=lengths)
+    q, k_pool, v_pool, tables, lens, *_ = case
+    return (q, k_pool, v_pool, quantize_pool(k_pool),
+            quantize_pool(v_pool), tables, lens)
+
+
+@pytest.mark.parametrize("page", [8, 16])
+@pytest.mark.parametrize("hq,hkv", [(4, 4),   # GQA group 1
+                                    (8, 2)])  # GQA group 4
+def test_int8_decode_kernel_matches_int8_xla(page, hq, hkv):
+    q, _, _, kq, vq, tables, lens = _quant_decode_case(
+        41 + page, page=page, hq=hq, hkv=hkv)
+    got = paged_decode_attention_pallas(q, kq, vq, tables, lens,
+                                        interpret=True)
+    want = paged_decode_attention_xla(q, kq, vq, tables, lens)
+    # compare valid slots only: the fallback's zero-length output is
+    # unmasked garbage by (pre-existing) contract, the kernel's is 0
+    np.testing.assert_allclose(np.asarray(got)[:2], np.asarray(want)[:2],
+                               rtol=2e-5, atol=2e-5)
+    assert not np.isnan(np.asarray(got)).any()
+    np.testing.assert_allclose(np.asarray(got)[2], 0.0, atol=1e-6)
+
+
+@pytest.mark.parametrize("page", [8, 16])
+def test_int8_decode_within_quant_bound_of_f32(page):
+    q, k_pool, v_pool, kq, vq, tables, lens = _quant_decode_case(
+        43 + page, page=page, hq=8, hkv=2)
+    got = paged_decode_attention_pallas(q, kq, vq, tables, lens,
+                                        interpret=True)
+    want = paged_decode_attention_xla(q, k_pool, v_pool, tables, lens)
+    np.testing.assert_allclose(np.asarray(got)[:2], np.asarray(want)[:2],
+                               atol=0.05)
+
+
+def _quant_chunk_case(seed, *, page, hq, hkv):
+    """Chunk shapes: histories starting mid-page (3, 9) and a
+    zero-length tail row."""
+    b, sq, hd, max_pages, n_pages = 3, 5, 16, 8, 32
+    ks = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(ks[0], (b, sq, hq, hd), jnp.float32)
+    k_pool = jax.random.normal(ks[1], (hkv, n_pages, page, hd),
+                               jnp.float32)
+    v_pool = jax.random.normal(ks[2], (hkv, n_pages, page, hd),
+                               jnp.float32)
+    history = jnp.asarray([3, 9, 0], jnp.int32)
+    chunk_lens = jnp.asarray([sq, 3, 0], jnp.int32)
+    rng = np.random.default_rng(seed)
+    tables = np.full((b, max_pages), n_pages, np.int32)
+    for i in range(b):
+        need = -(-int(history[i] + chunk_lens[i]) // page)
+        if need:
+            tables[i, :need] = rng.choice(n_pages, size=need,
+                                          replace=False)
+    return (q, k_pool, v_pool, quantize_pool(k_pool),
+            quantize_pool(v_pool), jnp.asarray(tables), history,
+            chunk_lens)
+
+
+@pytest.mark.parametrize("page", [8, 16])
+@pytest.mark.parametrize("hq,hkv", [(4, 4), (8, 2)])
+def test_int8_chunk_kernel_matches_int8_xla(page, hq, hkv):
+    (q, _, _, kq, vq, tables, history,
+     chunk_lens) = _quant_chunk_case(47 + page + hq, page=page,
+                                     hq=hq, hkv=hkv)
+    got = paged_chunk_attention_pallas(q, kq, vq, tables, history,
+                                       chunk_lens, interpret=True)
+    want = paged_chunk_attention_xla(q, kq, vq, tables, history,
+                                     chunk_lens)
+    assert not np.isnan(np.asarray(got)).any()
+    for i in range(3):
+        n = int(chunk_lens[i])  # rows past chunk_len are padding
+        np.testing.assert_allclose(np.asarray(got)[i, :n],
+                                   np.asarray(want)[i, :n],
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_int8_chunk_within_quant_bound_of_f32():
+    (q, k_pool, v_pool, kq, vq, tables, history,
+     chunk_lens) = _quant_chunk_case(53, page=8, hq=8, hkv=2)
+    got = paged_chunk_attention_pallas(q, kq, vq, tables, history,
+                                       chunk_lens, interpret=True)
+    want = paged_chunk_attention_xla(q, k_pool, v_pool, tables,
+                                     history, chunk_lens)
+    for i in range(3):
+        n = int(chunk_lens[i])
+        np.testing.assert_allclose(np.asarray(got)[i, :n],
+                                   np.asarray(want)[i, :n], atol=0.05)
+
+
+def test_int8_page_alignment_requires_32_rows():
+    """int8 VMEM tiles are (32, 128): the compiled path must reject
+    pages under 32 rows with the actionable error (a 16-row page is
+    legal for f32's 8-row tiles), while interpret mode — no tiling —
+    still accepts it so CPU tests can use small pages."""
+    q, k_pool, v_pool, kq, vq, tables, lens = _quant_decode_case(
+        59, page=16, hq=4, hkv=4)
+    with pytest.raises(ValueError, match="multiple of 32"):
+        paged_decode_attention_pallas(q, kq, vq, tables, lens,
+                                      interpret=False)
+    paged_decode_attention_pallas(q, kq, vq, tables, lens,
+                                  interpret=True)
+
+
 # ------------------------------------------------- engine-level parity
 
 def test_paged_native_engine_matches_slot_engine():

@@ -138,6 +138,95 @@ def test_scatter_decode_past_view_end_drops():
     np.testing.assert_array_equal(got, untouched)
 
 
+# ---------------------------------------------------- quantized pools
+
+from gofr_tpu.ops.paged_kv import (dequantize_rows, is_quantized_pool,  # noqa: E402
+                                   pool_row_bytes, quantize_pool,
+                                   quantize_rows)
+
+
+def _qpool():
+    return quantize_pool(_pool())
+
+
+def test_quantized_roundtrip_within_quant_bound():
+    """scatter (quantize-on-write) then gather (dequantize) reproduces
+    the written rows within the symmetric-int8 bound: per element the
+    error is at most scale/2 = amax/254."""
+    pool = _qpool()
+    tables = jnp.asarray([[2, 0, NP]], jnp.int32)
+    slab = jax.random.normal(jax.random.key(0), (L, 1, 8, H, D),
+                             jnp.float32)
+    pool = scatter_prefill(pool, tables, slab)
+    assert is_quantized_pool(pool)
+    view = gather_view(pool, tables, dtype=jnp.float32)
+    err = np.abs(np.asarray(view[:, :, :8]) - np.asarray(slab))
+    bound = np.max(np.abs(np.asarray(slab)), axis=-1,
+                   keepdims=True) / 254 + 1e-6
+    assert (err <= bound).all()
+
+
+def test_quantized_decode_append_preserves_earlier_rows():
+    """Per-row scales are load-bearing: appending one decode row to a
+    partially filled page must leave every earlier row's codes AND
+    scale bit-identical (a page-wide amax would re-quantize them)."""
+    pool = _qpool()
+    tables = jnp.asarray([[3, NP, NP]], jnp.int32)
+    slab = jax.random.normal(jax.random.key(1), (L, 1, 4, H, D),
+                             jnp.float32) * 5.0
+    pool = scatter_prefill(pool, tables, slab[:, :, :3])  # rows 0..2
+    before_q = np.asarray(pool["q"][:, :, 3, :3]).copy()
+    before_s = np.asarray(pool["s"][:, :, 3, :3]).copy()
+    # append logical row 3 (offset 3 of page 3) with a much larger amax
+    view = jnp.zeros((L, 1, 12, H, D), jnp.float32)
+    view = view.at[:, 0, 3].set(100.0)
+    pool = scatter_decode(pool, tables, view, jnp.asarray([3]), 1)
+    np.testing.assert_array_equal(np.asarray(pool["q"][:, :, 3, :3]),
+                                  before_q)
+    np.testing.assert_array_equal(np.asarray(pool["s"][:, :, 3, :3]),
+                                  before_s)
+    got = dequantize_rows(pool["q"][:, :, 3, 3], pool["s"][:, :, 3, 3])
+    np.testing.assert_allclose(np.asarray(got), 100.0, rtol=1e-2)
+
+
+def test_quantized_view_roundtrip_is_idempotent():
+    """The view fallback round-trips untouched rows (gather ->
+    dequantize -> requantize -> scatter). Requantizing dequantized
+    values must reproduce the exact codes and scale: each written row
+    has an element at |q| = 127, so the amax — and everything derived
+    from it — is reconstructed bit-for-bit. Zero rows hit the scale
+    floor and stay exactly zero."""
+    rows = jnp.concatenate([
+        jax.random.normal(jax.random.key(2), (6, D), jnp.float32),
+        jnp.zeros((2, D), jnp.float32)])
+    q1, s1 = quantize_rows(rows)
+    q2, s2 = quantize_rows(dequantize_rows(q1, s1))
+    np.testing.assert_array_equal(np.asarray(q1), np.asarray(q2))
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+
+
+def test_quantized_scatter_drops_like_plain():
+    """OOB table entries drop on BOTH leaves — dummy rows must not
+    corrupt codes or scales."""
+    pool = _qpool()
+    q0 = np.asarray(pool["q"]).copy()
+    s0 = np.asarray(pool["s"]).copy()
+    tables = jnp.asarray([[NP, NP, NP]], jnp.int32)
+    slab = jnp.ones((L, 1, 4, H, D), jnp.float32)
+    pool = scatter_chunk(pool, tables, slab, jnp.asarray([0]),
+                         jnp.asarray([4]))
+    np.testing.assert_array_equal(np.asarray(pool["q"]), q0)
+    np.testing.assert_array_equal(np.asarray(pool["s"]), s0)
+
+
+def test_quantized_row_bytes_accounting():
+    """int8 rows cost hd + 4 bytes per (layer, head) vs 4*hd for the
+    f32 source pool — the engine's byte-budget sizing leans on this."""
+    plain, quant = _pool(), _qpool()
+    assert pool_row_bytes(plain) == L * H * D * 4
+    assert pool_row_bytes(quant) == L * H * (D + 4)
+
+
 # ---------------------------------------------------------------- engine
 
 from gofr_tpu.serving.engine import EngineConfig, SamplingParams  # noqa: E402
@@ -241,6 +330,120 @@ def test_recovered_pool_keeps_head_major_layout():
     assert eng.k_cache.shape == shape_before
     assert eng.v_cache.shape == shape_before
     # and the engine still serves after recovery
+    eng.start()
+    reqs = [eng.submit([3, 1, 4], SamplingParams(
+        temperature=0.0, max_new_tokens=6)) for _ in range(2)]
+    _drain(reqs)
+    eng.stop()
+    assert all(r.error is None for r in reqs), [r.error for r in reqs]
+    assert all(len(r.generated) == 6 for r in reqs)
+
+
+def test_kv_dtype_validation():
+    """Engine construction (where every config knob is validated)
+    rejects unknown kv_dtypes and int8/byte-budgets on the slot
+    layout — both only mean something for paged pools."""
+    with pytest.raises(ValueError, match="kv_dtype"):
+        demo_llama_engine(EngineConfig(kv_dtype="fp8"))
+    with pytest.raises(ValueError, match="kv_layout='paged'"):
+        demo_llama_engine(EngineConfig(kv_dtype="int8"))  # slot layout
+    with pytest.raises(ValueError, match="kv_pool_bytes"):
+        demo_llama_engine(EngineConfig(kv_pool_bytes=1 << 20))
+
+
+def test_int8_view_and_native_paths_agree_exactly():
+    """The int8 view fallback (gather + dense decode + scatter) and the
+    int8 native path (pool_write + ragged XLA fallback) see the SAME
+    dequantized rows, so greedy outputs must agree token-for-token —
+    this pins the two quantized implementations against each other the
+    way the bf16 paths are pinned against the slot engine."""
+    def run(**extra):
+        eng = demo_llama_engine(EngineConfig(
+            max_batch=2, max_seq=128, seed=13, kv_layout="paged",
+            page_size=16, kv_dtype="int8", **extra))
+        eng.start()
+        reqs = [eng.submit(list(range(2, 9)), SamplingParams(
+            temperature=0.0, max_new_tokens=12)) for _ in range(2)]
+        _drain(reqs)
+        eng.stop()
+        assert all(r.error is None for r in reqs), [r.error for r in reqs]
+        return [r.generated for r in reqs]
+
+    view = run()                               # auto on CPU -> view
+    native = run(paged_attention="xla")
+    assert view == native
+    assert all(len(t) == 12 for t in view)
+
+
+def test_int8_engine_greedy_close_to_bf16():
+    """End-to-end accuracy bound: int8 KV shifts logits by the quant
+    error, which a tiny random model (near-uniform logits) amplifies —
+    real checkpoints have far larger logit margins. The documented
+    tolerance is therefore token-LEVEL, not bitwise: at least half the
+    greedy tokens must agree with the f32-KV engine's, and both runs
+    must complete error-free."""
+    def run(dt):
+        eng = demo_llama_engine(EngineConfig(
+            max_batch=2, max_seq=128, seed=19, kv_layout="paged",
+            page_size=16, kv_dtype=dt))
+        eng.start()
+        reqs = [eng.submit([3, 1, 4, 1, 5], SamplingParams(
+            temperature=0.0, max_new_tokens=12)) for _ in range(2)]
+        _drain(reqs)
+        eng.stop()
+        assert all(r.error is None for r in reqs), [r.error for r in reqs]
+        return [r.generated for r in reqs]
+
+    want, got = run("bf16"), run("int8")
+    agree = sum(a == b for w, g in zip(want, got)
+                for a, b in zip(w, g))
+    total = sum(len(w) for w in want)
+    assert agree >= total // 2, (want, got)
+
+
+def test_int8_pool_doubles_pages_at_same_byte_budget():
+    """Capacity is the point: at one fixed kv_pool_bytes budget the
+    int8 pool must hold >= 1.8x the pages of the bf16 pool. Uses
+    head_dim=64 (ratio 2*hd/(hd+4) = 1.88); the tiny config's hd=16
+    would overstate the win (its f32 pools give 3.2x)."""
+    import jax as _jax
+
+    from gofr_tpu.models.llama import LlamaConfig, llama_init
+    from gofr_tpu.serving.glue import llama_engine
+
+    c = LlamaConfig(vocab_size=64, dim=256, n_layers=2, n_heads=4,
+                    n_kv_heads=2, ffn_dim=64, max_seq=256,
+                    dtype=jnp.bfloat16)
+    assert c.head_dim == 64
+    params = llama_init(_jax.random.key(0), c)
+    budget = 1 << 20
+
+    def pages(dt):
+        eng = llama_engine(params, c, EngineConfig(
+            max_batch=2, max_seq=256, kv_layout="paged", page_size=32,
+            kv_dtype=dt, kv_pool_bytes=budget), implementation="xla")
+        return eng._n_pages, eng._kv_bytes_total
+
+    bf16_pages, bf16_bytes = pages("bf16")
+    int8_pages, int8_bytes = pages("int8")
+    assert int8_pages >= 1.8 * bf16_pages, (int8_pages, bf16_pages)
+    # both pools actually fit the budget they were sized against
+    assert bf16_bytes <= budget and int8_bytes <= budget
+
+
+def test_recovered_pool_stays_quantized():
+    """_recover_lost_cache must rebuild the int8 pool in the SAME
+    quantized representation (a plain-array rebuild would break every
+    compiled graph's pytree signature)."""
+    eng = demo_llama_engine(EngineConfig(
+        max_batch=2, max_seq=64, seed=5, kv_layout="paged",
+        page_size=16, kv_dtype="int8"))
+    shape_before = eng.k_cache["q"].shape
+    eng.k_cache["q"].delete()
+    assert eng._kv_lost()                      # pytree-aware probe
+    eng._recover_lost_cache(RuntimeError("induced"))
+    assert eng.k_cache["q"].shape == shape_before
+    assert eng.k_cache["s"].shape == shape_before[:-1] + (1,)
     eng.start()
     reqs = [eng.submit([3, 1, 4], SamplingParams(
         temperature=0.0, max_new_tokens=6)) for _ in range(2)]

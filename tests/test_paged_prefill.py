@@ -168,9 +168,9 @@ def test_native_paged_hot_paths_never_gather_view(monkeypatch):
     calls = []
     real = paged_kv.gather_view
 
-    def spy(pool, tables):
-        calls.append(pool.shape)
-        return real(pool, tables)
+    def spy(pool, tables, dtype=None):
+        calls.append(jax.tree_util.tree_leaves(pool)[0].shape)
+        return real(pool, tables, dtype=dtype)
 
     monkeypatch.setattr(paged_kv, "gather_view", spy)
 

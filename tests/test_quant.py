@@ -217,3 +217,16 @@ def test_quantized_bytes_dtype_detection():
             "c": jnp.zeros((10,), jnp.int8),
             "d": jnp.zeros((10,), jnp.float32)}
     assert quantized_bytes(tree) == int(10 * 0.5 + 10 * 0.5 + 10 + 40)
+
+
+def test_quantized_bytes_covers_kv_pool_tree():
+    """The engine's kv_bytes accounting is quantized_bytes over the
+    (k_cache, v_cache) pytree — the paged pool's {"q", "s"} split must
+    sum codes + per-row scales, and the bf16 pool its plain array."""
+    from gofr_tpu.ops.paged_kv import quantize_pool
+    l, h, np_, pg, d = 2, 2, 4, 8, 16
+    plain = jnp.zeros((l, h, np_, pg, d), jnp.bfloat16)
+    assert quantized_bytes((plain, plain)) == 2 * l * h * np_ * pg * d * 2
+    qp = quantize_pool(plain)
+    want = l * h * np_ * pg * (d + 4)          # int8 codes + f32 scale
+    assert quantized_bytes((qp, qp)) == 2 * want
