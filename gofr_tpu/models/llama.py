@@ -357,7 +357,9 @@ def llama_prefill_chunk(params: dict, tokens: jnp.ndarray,
                         offsets: jnp.ndarray, chunk_lengths: jnp.ndarray,
                         config: LlamaConfig, *,
                         implementation: str = "auto",
-                        return_all_logits: bool = False
+                        return_all_logits: bool = False,
+                        tree_depths: jnp.ndarray | None = None,
+                        tree_masks: jnp.ndarray | None = None
                         ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One chunk of a chunked prefill: process ``tokens`` [B, S] whose
     row b starts at absolute position ``offsets[b]``, attending to the
@@ -370,18 +372,29 @@ def llama_prefill_chunk(params: dict, tokens: jnp.ndarray,
     chunks (long-context obligation, SURVEY §5). Returns
     (last-position logits [B, V], new_k_cache, new_v_cache); caches
     are [L, B, Smax, Hkv, hd] and meant to be donated.
+
+    ``tree_depths``/``tree_masks`` [B, S] (both or neither) switch the
+    chunk into draft-tree verify mode: row i is tree NODE i (node 0 =
+    root, topological order), RoPE runs at ``offsets + tree_depths``
+    (siblings share a depth), K/V rows land at node index
+    ``offsets + i`` (each node gets its own cache row — the engine
+    compacts the accepted path afterwards), and attention masks
+    in-chunk visibility by the packed ancestor bits instead of causal
+    order. ``None`` (the default) traces the exact historical graph.
     """
-    from ..ops.attention import attention
+    from ..ops.attention import attention, tree_attention
     c = config
     b, s = tokens.shape
     smax = k_cache.shape[2]
     hd = c.head_dim
     inv_freq = rope_frequencies(c.head_dim, c.rope_theta, c.rope_scaling)
-    positions = offsets[:, None] + jnp.arange(s)[None, :]      # [B, S]
+    node_pos = offsets[:, None] + jnp.arange(s)[None, :]       # [B, S]
+    positions = node_pos if tree_depths is None \
+        else offsets[:, None] + tree_depths
     valid = jnp.arange(s)[None, :] < chunk_lengths[:, None]    # [B, S]
     # invalid rows scatter out of bounds and drop — padded tail rows
     # must never overwrite live cache
-    write_pos = jnp.where(valid, positions, smax)
+    write_pos = jnp.where(valid, node_pos, smax)
     batch_idx = jnp.arange(b)
     x = qgather(params["embed"], tokens, c.dtype)
 
@@ -407,9 +420,15 @@ def llama_prefill_chunk(params: dict, tokens: jnp.ndarray,
         # positions <= offsets + s_i (earlier chunks + intra-chunk).
         # Dispatch follows the rest of the stack; q_offset != 0 routes
         # to the XLA path today, and a future history-aware kernel
-        # picks it up here.
-        out = attention(q, kc, vc, causal=True, q_offset=offsets,
-                        implementation=implementation)
+        # picks it up here. Tree verify swaps the intra-chunk causal
+        # mask for the packed ancestor bits.
+        if tree_masks is None:
+            out = attention(q, kc, vc, causal=True, q_offset=offsets,
+                            implementation=implementation)
+        else:
+            out = tree_attention(q, kc, vc, history_lens=offsets,
+                                 chunk_lens=chunk_lengths,
+                                 tree_masks=tree_masks)
         x = x + qmatmul(out.reshape(b, s, c.n_heads * hd), lp["wo"])
         x = x + _mlp_block(x, lp, c)
         return (x, kc_all, vc_all), None
@@ -433,7 +452,9 @@ def llama_prefill_chunk_paged(params: dict, tokens: jnp.ndarray,
                               chunk_lengths: jnp.ndarray,
                               config: LlamaConfig, *,
                               implementation: str = "auto",
-                              return_all_logits: bool = False
+                              return_all_logits: bool = False,
+                              tree_depths: jnp.ndarray | None = None,
+                              tree_masks: jnp.ndarray | None = None
                               ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One chunk of a chunked prefill straight against the paged pool.
 
@@ -454,8 +475,16 @@ def llama_prefill_chunk_paged(params: dict, tokens: jnp.ndarray,
     (last-position logits [B, V] — or all positions [B, S, V] with
     ``return_all_logits`` for speculative verify — new_k_pool,
     new_v_pool); pools are meant to be donated.
+
+    ``tree_depths``/``tree_masks`` [B, S] (both or neither) switch the
+    chunk into draft-tree verify mode, exactly as in
+    :func:`llama_prefill_chunk`: RoPE at ``offsets + tree_depths``,
+    K/V rows at node index ``offsets + i``, attention through
+    :func:`..ops.paged_attention.paged_tree_attention`'s packed
+    ancestor bitmask. ``None`` traces the historical graph.
     """
-    from ..ops.paged_attention import paged_chunk_attention
+    from ..ops.paged_attention import (paged_chunk_attention,
+                                       paged_tree_attention)
     from ..ops.paged_kv import pool_layer, pool_shape, pool_write
     c = config
     b, s = tokens.shape
@@ -463,14 +492,16 @@ def llama_prefill_chunk_paged(params: dict, tokens: jnp.ndarray,
     n_pages, pg = pool_shape(k_pool)[2:4]
     mp = tables.shape[1]
     inv_freq = rope_frequencies(c.head_dim, c.rope_theta, c.rope_scaling)
-    positions = offsets[:, None] + jnp.arange(s)[None, :]      # [B, S]
+    node_pos = offsets[:, None] + jnp.arange(s)[None, :]       # [B, S]
+    positions = node_pos if tree_depths is None \
+        else offsets[:, None] + tree_depths
     valid = jnp.arange(s)[None, :] < chunk_lengths[:, None]    # [B, S]
     # page id + in-page offset per written position; padding rows and
     # positions past the table map to the OOB id and drop on scatter
     pids = jnp.take_along_axis(
-        tables, jnp.clip(positions // pg, 0, mp - 1), axis=1)  # [B, S]
-    pids = jnp.where(valid & (positions < mp * pg), pids, n_pages)
-    offs = positions % pg
+        tables, jnp.clip(node_pos // pg, 0, mp - 1), axis=1)   # [B, S]
+    pids = jnp.where(valid & (node_pos < mp * pg), pids, n_pages)
+    offs = node_pos % pg
     x = qgather(params["embed"], tokens, c.dtype)
 
     # pools ride the scan carry (see llama_decode_step_paged); the
@@ -490,9 +521,14 @@ def llama_prefill_chunk_paged(params: dict, tokens: jnp.ndarray,
         vp_all = pool_write(vp_all, li, pids, offs, v)
         kp = pool_layer(kp_all, li)
         vp = pool_layer(vp_all, li)
-        out = paged_chunk_attention(q, kp, vp, tables, offsets,
-                                    chunk_lengths,
-                                    implementation=implementation)
+        if tree_masks is None:
+            out = paged_chunk_attention(q, kp, vp, tables, offsets,
+                                        chunk_lengths,
+                                        implementation=implementation)
+        else:
+            out = paged_tree_attention(q, kp, vp, tables, offsets,
+                                       chunk_lengths, tree_masks,
+                                       implementation=implementation)
         x = x + qmatmul(out.reshape(b, s, c.n_heads * hd), lp["wo"])
         x = x + _mlp_block(x, lp, c)
         return (x, kp_all, vp_all), None

@@ -74,6 +74,49 @@ def xla_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     return out.astype(q.dtype)
 
 
+def tree_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                   history_lens: jnp.ndarray,
+                   chunk_lens: jnp.ndarray,
+                   tree_masks: jnp.ndarray,
+                   scale: float | None = None) -> jnp.ndarray:
+    """Draft-tree verify attention. q [B,Sq,Hq,D] holds Sq tree nodes
+    per slot (topological order, node 0 = root); k/v [B,Skv,Hkv,D] hold
+    the history followed by the tree nodes at rows
+    ``[history_lens, history_lens + chunk_lens)``. Node i attends every
+    history row plus exactly the in-tree rows whose bit is set in
+    ``tree_masks[b, i]`` (packed ancestor-or-self bits over the
+    in-chunk node index — Sq <= 32). Fully-masked rows return zeros,
+    matching the paged kernel's denom-clamp contract."""
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    if sq > 32:
+        raise ValueError(f"tree width {sq} exceeds the 32-node packed "
+                         f"ancestor bitmask")
+    group = hq // hkv
+    k = _repeat_kv(k, group)
+    v = _repeat_kv(v, group)
+    scale = scale if scale is not None else d ** -0.5
+
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+
+    kv_pos = jnp.arange(skv)[None, None, :]               # [1, 1, Skv]
+    rel = kv_pos - history_lens[:, None, None]            # [B, 1, Skv]
+    bit = (tree_masks[:, :, None].astype(jnp.int32)
+           >> jnp.clip(rel, 0, 31)) & 1                   # [B, Sq, Skv]
+    visible = (rel < 0) | ((rel < chunk_lens[:, None, None]) & (bit == 1))
+    logits = jnp.where(visible[:, None, :, :], logits, NEG_INF)
+
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", weights, v.astype(jnp.float32))
+    # a fully-masked node row (padding with no history) softmaxes to a
+    # uniform average of garbage — zero it like the kernel does
+    any_visible = visible.any(axis=-1)                    # [B, Sq]
+    out = jnp.where(any_visible[:, :, None, None], out,
+                    jnp.zeros_like(out))
+    return out.astype(q.dtype)
+
+
 def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
                      kv_lengths: jnp.ndarray,
                      scale: float | None = None) -> jnp.ndarray:

@@ -342,8 +342,14 @@ def paged_decode_attention_xla(q: jnp.ndarray, k_pool,
     from .attention import decode_attention
     k_view = _slot_view(k_pool, tables)
     v_view = _slot_view(v_pool, tables)
-    return decode_attention(q[:, None], k_view, v_view, lengths,
-                            scale=scale)[:, 0]
+    out = decode_attention(q[:, None], k_view, v_view, lengths,
+                           scale=scale)[:, 0]
+    # zero-length slots: every position is masked, so the dense softmax
+    # degrades to a uniform average over garbage rows — the kernel's
+    # denom clamp returns exact zeros there. Match it, so the fallback
+    # and the kernel agree on EVERY row, not just live ones.
+    return jnp.where(lengths[:, None, None] > 0, out,
+                     jnp.zeros_like(out))
 
 
 # ----------------------------------------------------- chunk (Sq > 1)
@@ -431,8 +437,13 @@ def _paged_chunk_kernel(tables_ref, history_ref, chunk_ref, q_ref,
             jnp.int32, s.shape, 1)
         # causal against history + in-chunk prefix: position p is
         # visible to query q_idx iff p <= history + q_idx (the chunk's
-        # own row q_idx was written before attention, like decode)
-        visible = pos <= q_pos
+        # own row q_idx was written before attention, like decode).
+        # The pos < hist + clen bound is a no-op for valid rows
+        # (q_idx < clen implies q_pos < hist + clen) but turns
+        # zero-length slots — hist == clen == 0, every position masked
+        # — into exact zeros via the denom clamp instead of finite
+        # garbage, matching the decode kernel's contract.
+        visible = (pos <= q_pos) & (pos < hist + clen)
         s = jnp.where(visible, s, NEG_INF)
 
         m_prev = m_ref[:]
@@ -478,8 +489,10 @@ def paged_chunk_attention_pallas(q: jnp.ndarray, k_pool,
     ``[history_lens, history_lens + chunk_lens)``; pools
     [Hkv, Np, pg, hd] (plain) or the ``{"q", "s"}`` quantized pytree.
     Query row i of slot b attends causally to pool
-    rows <= history_lens[b] + i. Rows past ``chunk_lens[b]`` are
-    padding: their output is finite garbage the caller discards."""
+    rows <= history_lens[b] + i, bounded by the slot's written total
+    ``history + chunk``. Rows past ``chunk_lens[b]`` are padding the
+    caller discards; zero-length slots (history == chunk == 0) return
+    exact zeros, like the decode kernel."""
     k_codes, k_scales = _split_pool(k_pool)
     v_codes, v_scales = _split_pool(v_pool)
     quantized = k_scales is not None
@@ -573,10 +586,279 @@ def paged_chunk_attention_xla(q: jnp.ndarray, k_pool,
     from .attention import xla_attention
     k_view = _slot_view(k_pool, tables)
     v_view = _slot_view(v_pool, tables)
-    return xla_attention(q, k_view, v_view, causal=True,
-                         q_offset=history_lens,
-                         kv_lengths=history_lens + chunk_lens,
-                         scale=scale)
+    out = xla_attention(q, k_view, v_view, causal=True,
+                        q_offset=history_lens,
+                        kv_lengths=history_lens + chunk_lens,
+                        scale=scale)
+    # zero-length slots (hist == clen == 0): every position is masked
+    # and the dense softmax degrades to a uniform average over garbage
+    # — the kernel returns exact zeros there. Match it so kernel and
+    # fallback agree on every row of every slot.
+    total = history_lens + chunk_lens
+    return jnp.where(total[:, None, None, None] > 0, out,
+                     jnp.zeros_like(out))
+
+
+# ---------------------------------------------- tree verify (Sq > 1)
+#
+# Speculative tree verify: the Sq rows of a verify pass are NODES of a
+# draft tree (node 0 = the committed root token, nodes packed
+# topologically so every parent index < child index), not a linear
+# chunk. Node i must attend the full history plus its ANCESTOR nodes
+# only — two sibling branches must not see each other, or the verify
+# logits would differ from the sequential decode they stand in for.
+# The per-node ancestor set rides as a packed int32 bitmask
+# (bit j set iff node j is an ancestor of node i, or j == i), which
+# caps the tree at 32 nodes — far above any sane draft budget.
+
+def _paged_tree_kernel(tables_ref, history_ref, chunk_ref, tree_ref,
+                       q_ref, k_hbm, v_hbm, *rest, page: int,
+                       pages_per_chunk: int, max_pages: int,
+                       n_pages: int, scale: float, block_q: int,
+                       group: int, quantized: bool = False):
+    if quantized:
+        (ks_hbm, vs_hbm, o_ref, k_buf, v_buf, ks_buf, vs_buf,
+         acc_ref, m_ref, l_ref, sems) = rest
+    else:
+        o_ref, k_buf, v_buf, acc_ref, m_ref, l_ref, sems = rest
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    qb = pl.program_id(2)
+    chunk = pages_per_chunk * page
+    hist = history_ref[b]
+    clen = chunk_ref[b]
+    # topological packing (parent < child) means a node's ancestors
+    # all sit at lower rows, so the chunk kernel's ragged page walk
+    # bound is still exact: block qb never needs rows past
+    # hist + min((qb+1)*BQ, clen)
+    kv_limit = hist + jnp.minimum((qb + 1) * block_q, clen)
+    n_chunks = jnp.maximum(pl.cdiv(kv_limit, chunk), 1)
+
+    def page_dmas(ci, slot):
+        dmas = []
+        for j in range(pages_per_chunk):
+            page_idx = jnp.minimum(ci * pages_per_chunk + j,
+                                   max_pages - 1)
+            pid = jnp.minimum(tables_ref[b, page_idx], n_pages - 1)
+            dst = pl.ds(j * page, page)
+            dmas.append(pltpu.make_async_copy(
+                k_hbm.at[h, pid], k_buf.at[slot, dst, :],
+                sems.at[slot, 0, j]))
+            dmas.append(pltpu.make_async_copy(
+                v_hbm.at[h, pid], v_buf.at[slot, dst, :],
+                sems.at[slot, 1, j]))
+            if quantized:
+                dmas.append(pltpu.make_async_copy(
+                    ks_hbm.at[h, pid], ks_buf.at[slot, dst, :],
+                    sems.at[slot, 2, j]))
+                dmas.append(pltpu.make_async_copy(
+                    vs_hbm.at[h, pid], vs_buf.at[slot, dst, :],
+                    sems.at[slot, 3, j]))
+        return dmas
+
+    def start_chunk(ci, slot):
+        for dma in page_dmas(ci, slot):
+            dma.start()
+
+    def wait_chunk(ci, slot):
+        for dma in page_dmas(ci, slot):
+            dma.wait()
+
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    start_chunk(0, 0)
+    rows = block_q * group
+    # broadcast each row's packed ancestor mask out of SMEM: a gather
+    # by traced per-row index is not Mosaic-expressible, but block_q
+    # is static and small, so an unrolled select ladder over the
+    # block's nodes builds the [rows, 1] mask vector from scalar loads
+    ridx = jax.lax.broadcasted_iota(
+        jnp.int32, (rows, 1), 0) // group       # local node 0..BQ-1
+    mask_row = jnp.zeros((rows, 1), jnp.int32)
+    for t in range(block_q):
+        mask_row = jnp.where(ridx == t,
+                             tree_ref[b, qb * block_q + t], mask_row)
+    qf = q_ref[0, 0].astype(jnp.float32) * scale        # [BQ*G, hd]
+
+    def body(ci, _):
+        slot = jax.lax.rem(ci, 2)
+
+        @pl.when(ci + 1 < n_chunks)
+        def _():
+            start_chunk(ci + 1, jax.lax.rem(ci + 1, 2))
+
+        wait_chunk(ci, slot)
+        k = k_buf[slot].astype(jnp.float32)             # [chunk, hd]
+        if quantized:
+            k = k * ks_buf[slot]
+        s = jax.lax.dot_general(
+            qf, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [BQ*G, chunk]
+        pos = ci * chunk + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        # history rows (pos < hist) are visible to every node; tree
+        # rows (rel = pos - hist in [0, clen)) are visible iff the
+        # node's ancestor bit for them is set
+        rel = pos - hist
+        bit = jax.lax.shift_right_logical(
+            mask_row, jnp.clip(rel, 0, 31)) & 1
+        visible = (rel < 0) | ((rel < clen) & (bit == 1))
+        s = jnp.where(visible, s, NEG_INF)
+
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(visible, jnp.exp(s - m_new), 0.0)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_buf[slot].astype(jnp.float32)             # [chunk, hd]
+        if quantized:
+            v = v * vs_buf[slot]
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [BQ*G, hd]
+        m_ref[:] = m_new
+        return 0
+
+    jax.lax.fori_loop(0, n_chunks, body, 0)
+    denom = jnp.maximum(l_ref[:], 1e-30)  # all-masked rows: zeros
+    o_ref[0, 0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def paged_tree_attention_pallas(q: jnp.ndarray, k_pool,
+                                v_pool, tables: jnp.ndarray,
+                                history_lens: jnp.ndarray,
+                                chunk_lens: jnp.ndarray,
+                                tree_masks: jnp.ndarray, *,
+                                scale: float | None = None,
+                                block_q: int | None = None,
+                                interpret: bool = False) -> jnp.ndarray:
+    """Tree-verify attention. q [B, Sq, Hq, hd] holds the Sq draft-tree
+    nodes per slot, already written into the pool at rows
+    ``[history_lens, history_lens + chunk_lens)`` in topological order
+    (parent row < child row); ``tree_masks`` [B, Sq] int32 packs each
+    node's ancestor-or-self set as bits over the in-chunk node index.
+    Node i of slot b attends pool rows < history_lens[b] plus in-chunk
+    rows j with bit j of tree_masks[b, i] set. Nodes past
+    ``chunk_lens[b]`` are padding; a fully-masked row returns zeros."""
+    k_codes, k_scales = _split_pool(k_pool)
+    v_codes, v_scales = _split_pool(v_pool)
+    quantized = k_scales is not None
+    b, sq, hq, hd = q.shape
+    if sq > 32:
+        raise ValueError(f"tree width {sq} exceeds the 32-node packed "
+                         f"ancestor bitmask")
+    hkv, n_pages, page, _ = k_codes.shape
+    _, max_pages = tables.shape
+    group = hq // hkv
+    scale = scale if scale is not None else hd ** -0.5
+    if block_q is None:
+        block_q = _pick_block_q(sq)
+    if sq % block_q != 0:
+        raise ValueError(f"block_q {block_q} must divide Sq {sq}")
+    _check_page_alignment(page, interpret, quantized)
+
+    pages_per_chunk = max(1, min(max_pages, -(-128 // page)))
+    chunk = pages_per_chunk * page
+
+    group_p = _pad_group(group, block_q)
+    q5 = q.reshape(b, sq, hkv, group, hd)
+    if group_p != group:
+        q5 = jnp.pad(q5, ((0, 0), (0, 0), (0, 0),
+                          (0, group_p - group), (0, 0)))
+    q4 = q5.transpose(0, 2, 1, 3, 4).reshape(b, hkv, sq * group_p, hd)
+    kernel = functools.partial(
+        _paged_tree_kernel, page=page, pages_per_chunk=pages_per_chunk,
+        max_pages=max_pages, n_pages=n_pages, scale=scale,
+        block_q=block_q, group=group_p, quantized=quantized)
+    rows = block_q * group_p
+    scale_specs = [pl.BlockSpec(memory_space=pl.ANY)] * 2 \
+        if quantized else []
+    scale_bufs = [pltpu.VMEM((2, chunk, 1), jnp.float32)] * 2 \
+        if quantized else []
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(b, hkv, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, rows, hd),
+                         lambda i, j, k, *_: (i, j, k, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),      # k pool stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),      # v pool stays in HBM
+            *scale_specs,
+        ],
+        out_specs=pl.BlockSpec((1, 1, rows, hd),
+                               lambda i, j, k, *_: (i, j, k, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk, hd), k_codes.dtype),
+            pltpu.VMEM((2, chunk, hd), v_codes.dtype),
+            *scale_bufs,
+            pltpu.VMEM((rows, hd), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 4 if quantized else 2,
+                                     pages_per_chunk)),
+        ],
+    )
+    args = [tables.astype(jnp.int32), history_lens.astype(jnp.int32),
+            chunk_lens.astype(jnp.int32), tree_masks.astype(jnp.int32),
+            q4, k_codes, v_codes]
+    if quantized:
+        args += [k_scales, v_scales]
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, sq * group_p, hd),
+                                       q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel")),
+        interpret=interpret,
+    )(*args)
+    return out.reshape(b, hkv, sq, group_p, hd) \
+        .transpose(0, 2, 1, 3, 4)[:, :, :, :group] \
+        .reshape(b, sq, hq, hd)
+
+
+def paged_tree_attention_xla(q: jnp.ndarray, k_pool,
+                             v_pool, tables: jnp.ndarray,
+                             history_lens: jnp.ndarray,
+                             chunk_lens: jnp.ndarray,
+                             tree_masks: jnp.ndarray, *,
+                             scale: float | None = None) -> jnp.ndarray:
+    """Reference path: gather the slot views, run dense tree-masked
+    attention. Materialises [B, Mp*pg, Hkv, hd] per call."""
+    from .attention import tree_attention
+    k_view = _slot_view(k_pool, tables)
+    v_view = _slot_view(v_pool, tables)
+    return tree_attention(q, k_view, v_view,
+                          history_lens=history_lens,
+                          chunk_lens=chunk_lens,
+                          tree_masks=tree_masks, scale=scale)
+
+
+def paged_tree_attention(q: jnp.ndarray, k_pool,
+                         v_pool, tables: jnp.ndarray,
+                         history_lens: jnp.ndarray,
+                         chunk_lens: jnp.ndarray,
+                         tree_masks: jnp.ndarray, *,
+                         scale: float | None = None,
+                         implementation: str = "auto") -> jnp.ndarray:
+    """Dispatch wrapper. implementation: 'pallas'|'interpret'|'xla'|'auto'."""
+    if implementation == "pallas" or (
+            implementation == "auto" and _is_tpu()):
+        return paged_tree_attention_pallas(q, k_pool, v_pool, tables,
+                                           history_lens, chunk_lens,
+                                           tree_masks, scale=scale)
+    if implementation == "interpret":
+        return paged_tree_attention_pallas(q, k_pool, v_pool, tables,
+                                           history_lens, chunk_lens,
+                                           tree_masks, scale=scale,
+                                           interpret=True)
+    return paged_tree_attention_xla(q, k_pool, v_pool, tables,
+                                    history_lens, chunk_lens, tree_masks,
+                                    scale=scale)
 
 
 def paged_chunk_attention(q: jnp.ndarray, k_pool,

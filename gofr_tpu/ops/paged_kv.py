@@ -235,6 +235,45 @@ def scatter_decode(pool, tables: jnp.ndarray,
     return _pool_set(pool, pids, offs, rows)
 
 
+def pool_move_rows(pool, tables: jnp.ndarray,
+                   src_pos: jnp.ndarray, dst_pos: jnp.ndarray):
+    """Move KV rows between logical positions of each slot:
+    row ``src_pos[b, k]`` -> ``dst_pos[b, k]`` through slot b's table.
+    Used by speculative tree verify to compact the accepted
+    root-to-leaf path out of the node-indexed scratch rows.
+
+    Moves the RAW pool representation — int8 codes plus their f32
+    scale rows for quantized pools — so the copy is exact by
+    construction: no dequantize/requantize round trip. All gathers
+    complete before any scatter (one advanced-index gather, one
+    scatter), so overlapping src/dst sets cannot order-corrupt.
+    Entries with ``dst_pos`` outside the slot's table (the caller's
+    "no move" sentinel) drop; ``src_pos`` for those entries may be
+    anything in-range-clamped.
+    """
+    n_pages, pg = pool_shape(pool)[2:4]
+    mp = tables.shape[1]
+
+    def coords(pos, clamp):
+        pids = jnp.take_along_axis(
+            tables, jnp.clip(pos // pg, 0, mp - 1), axis=1)
+        pids = jnp.where((pos >= 0) & (pos < mp * pg), pids, n_pages)
+        if clamp:
+            pids = jnp.minimum(pids, n_pages - 1)
+        return pids, pos % pg
+
+    s_pids, s_offs = coords(src_pos, clamp=True)
+    d_pids, d_offs = coords(dst_pos, clamp=False)
+
+    def move(arr):
+        rows = arr[:, :, s_pids, s_offs]            # [L, H, B, K, d]
+        return arr.at[:, :, d_pids, d_offs].set(rows, mode="drop")
+
+    if is_quantized_pool(pool):
+        return {k: move(pool[k]) for k in QUANT_KEYS}
+    return move(pool)
+
+
 def pool_from_cache_shape(k_cache: jnp.ndarray) -> jnp.ndarray:
     """Re-lay a dense [L, Np, pg, H, d] allocation (what
     ``make_cache(n_pages, page)`` returns) as the head-major pool

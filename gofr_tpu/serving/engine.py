@@ -52,6 +52,8 @@ import numpy as np
 
 from ..analysis.annotations import hot_path, hot_path_boundary
 from .faults import NO_FAULTS, resolve_plan
+from .spec import (MAX_TREE_NODES, DraftTree, NgramIndex, SpecController,
+                   build_draft_tree)
 
 NEG_INF = -1e30
 
@@ -115,6 +117,10 @@ class GenRequest:
                                     # preemption" column
     waste_spec_s: float = 0.0       # slice of device_s spent on this
                                     # request's REJECTED draft tokens
+    spec_index: Any = None          # per-request NgramIndex (lazy; fed
+                                    # incrementally by _draft_proposals,
+                                    # rebuilt when the token stream is
+                                    # rewritten by preempt/recover)
     lane: str = "interactive"      # scheduler lane (interactive |
                                    # background); explicit submit() lane
                                    # wins over the config's tenant->lane
@@ -298,6 +304,22 @@ class EngineConfig:
     spec_draft: int = 4
     #: n-gram width the prompt-lookup draft matches on
     spec_ngram: int = 3
+    #: candidate continuations drafted per pass: the n-gram index
+    #: proposes up to this many distinct continuations, trie-merged
+    #: into ONE draft tree and verified together under a packed
+    #: ancestor bitmask (1 + spec_draft * spec_branches <= 32 nodes).
+    spec_branches: int = 2
+    #: goodput-driven draft controller: per-slot accept-rate EWMA
+    #: priced against fitted decode sec/token and verify row cost —
+    #: drafting shrinks/stops per slot when expected accepted tokens
+    #: stop paying for the marginal verify rows. False = the static
+    #: always-full-depth policy.
+    spec_adaptive: bool = True
+    #: accept-rate EWMA floor under which a slot's drafting is
+    #: disabled (re-probed every spec_probe_interval passes)
+    spec_accept_floor: float = 0.1
+    #: passes between single-node probes of a disabled slot
+    spec_probe_interval: int = 32
     #: paged layout decode path: "auto" = the ragged paged-attention
     #: kernel on TPU (pages read in place, no per-pass view
     #: materialisation) and the gather/scatter view path elsewhere;
@@ -476,6 +498,19 @@ class Engine:
         self._spec_enabled = (config.speculative
                               and spec_verify_fn is not None)
         self._spec_toggle = True  # mixed-batch alternation state
+        #: goodput-priced speculation policy (serving/spec.py); always
+        #: constructed so /debug/efficiency can report it, only
+        #: consulted when _spec_enabled
+        self._spec_ctrl = SpecController(
+            config.max_batch, draft=config.spec_draft,
+            branches=config.spec_branches,
+            adaptive=config.spec_adaptive,
+            accept_floor=config.spec_accept_floor,
+            probe_interval=config.spec_probe_interval)
+        #: request each controller slot's state belongs to — the
+        #: drafting loop resets a slot's EWMA when its tenant changes
+        #: (cheaper than hooking every admit/retire site)
+        self._spec_ctrl_owner: list = [None] * config.max_batch
 
         cfg = config
         if cfg.kv_layout not in ("slot", "paged"):
@@ -496,6 +531,21 @@ class Engine:
         if cfg.kv_pool_bytes is not None and cfg.kv_layout != "paged":
             raise ValueError("kv_pool_bytes sizes the paged pool; "
                              "set kv_layout='paged'")
+        if cfg.spec_branches < 1:
+            raise ValueError(f"spec_branches must be >= 1, got "
+                             f"{cfg.spec_branches}")
+        if 1 + cfg.spec_draft * cfg.spec_branches > MAX_TREE_NODES:
+            raise ValueError(
+                f"1 + spec_draft * spec_branches = "
+                f"{1 + cfg.spec_draft * cfg.spec_branches} exceeds the "
+                f"{MAX_TREE_NODES}-node packed ancestor bitmask; shrink "
+                f"spec_draft or spec_branches")
+        if not 0.0 < cfg.spec_accept_floor < 1.0:
+            raise ValueError(f"spec_accept_floor must be in (0, 1), "
+                             f"got {cfg.spec_accept_floor}")
+        if cfg.spec_probe_interval < 1:
+            raise ValueError(f"spec_probe_interval must be >= 1, got "
+                             f"{cfg.spec_probe_interval}")
         #: dtype the dequantized view/model side of a quantized pool
         #: uses (set by _alloc_pool from the probe allocation); None
         #: until a pool exists — plain pools ignore it entirely
@@ -1059,6 +1109,11 @@ class Engine:
             self.k_cache, self.v_cache = self._make_cache(
                 cfg.max_batch, cfg.max_seq)
         self.lengths[:] = 0
+        # speculation: slot ownership is void (every slot re-admits),
+        # so the next drafting pass re-seeds each slot's accept EWMA;
+        # the controller's fitted costs and lifetime totals survive —
+        # restart doesn't change what a token costs
+        self._spec_ctrl_owner = [None] * cfg.max_batch
 
     def health_check(self) -> dict:
         status = "DOWN" if (self._failed or not self._running) else "UP"
@@ -1139,6 +1194,9 @@ class Engine:
              "pages/rows (scale leaves included for int8 pools)"),
             ("app_engine_host_rss_bytes_watermark",
              "host process RSS high-water mark (ru_maxrss)"),
+            ("app_engine_spec_accept_rate",
+             "lifetime speculative draft acceptance rate "
+             "(accepted/drafted; 1.0 before any drafting)"),
         ):
             if metrics.get(name) is None:
                 metrics.new_gauge(name, desc)
@@ -1385,10 +1443,37 @@ class Engine:
                             self._prefill_base_key)
                         jax.block_until_ready(toks)
         if self._spec_enabled:
-            # the verify graph's width is static and its lazy first
-            # compile on the serving path is expected, not a regression
-            self.sentinel.observe(
-                self._sig("spec_verify", cfg.spec_draft + 1))
+            # tree-verify graphs: one per pow-2 width bucket
+            # (_spec_pass picks the smallest bucket holding the pass's
+            # widest tree). Observe AND eagerly compile every bucket —
+            # the sealed sentinel treats any unseen post-warmup
+            # signature as a regression, and a lazy first compile
+            # would stall the serving loop mid-stream. All rows are
+            # dummies (OOB offsets/tables): every cache write drops.
+            b = cfg.max_batch
+            spec_tables = (jnp.full((b, self._pages_per_slot),
+                                    self._n_pages, jnp.int32),) \
+                if paged else ()
+            fn = self._get_spec_verify()
+            cap = 1 + cfg.spec_draft * cfg.spec_branches
+            w = 2
+            while True:
+                self.sentinel.observe(self._sig("spec_verify", w))
+                _, bonus, _, self.k_cache, self.v_cache = fn(
+                    self.params, jnp.zeros((b, w), jnp.int32),
+                    jnp.zeros((b, w), jnp.int32),
+                    jnp.zeros((b, w), jnp.int32),
+                    jnp.ones((b, w), jnp.int32),
+                    self.k_cache, self.v_cache, *spec_tables,
+                    jnp.full(b, cfg.max_seq, jnp.int32),
+                    jnp.ones(b, jnp.int32), np.int32(0),
+                    jnp.zeros(b, jnp.float32),
+                    jnp.ones(b, jnp.float32),
+                    jnp.zeros(b, jnp.int32), self._prefill_base_key)
+                jax.block_until_ready(bonus)
+                if w >= cap:
+                    break
+                w *= 2
         self.sentinel.seal()
 
     def _clamp_prompt(self, tokens: list[int], max_new: int) -> list[int]:
@@ -3004,6 +3089,10 @@ class Engine:
         # pending-prefill sentinels and retired requests riding out a
         # pipelined pass are padding waste
         self.goodput.add_decode(busy, credited, self.config.max_batch)
+        # fit the controller's sec/token price from the same busy span
+        # the goodput ledger bills — an accepted draft token is worth
+        # exactly what a plain-decode token costs
+        self._spec_ctrl.note_decode(busy, emitted)
         if self.recorder.enabled:
             # the pass record: everything here is a host int/float the
             # collect already computed — no device reads beyond the
@@ -3019,12 +3108,19 @@ class Engine:
 
     # ------------------------------------------------- speculative decode
     def _get_spec_verify(self) -> Callable:
-        """Fused verify pass over all slots: feed [last_token,
-        d_1..d_D] per row at its cache offset, greedy-predict every
-        position, count the accepted draft prefix in-graph, and emit
-        one bonus token sampled at the first divergence — per-row
+        """Fused tree-verify pass over all slots: feed each row's
+        draft tree (node 0 = the committed last token, topological
+        packing) at its cache offset, greedy-predict every node under
+        the packed ancestor bitmask, resolve the longest fully
+        accepted root-to-leaf path in-graph, compact the accepted
+        path's KV rows into contiguous cache positions, and emit one
+        bonus token sampled at the deepest accepted node — per-row
         sampling params decide the bonus (greedy rows take the argmax
-        path inside _sample_batch). Returns (accepted[B], bonus[B])."""
+        path inside _sample_batch). Returns (accepted[B], bonus[B],
+        path[B, W]): ``path[b, k]`` is the node index at depth k of
+        the accepted path, valid for k <= accepted[b]. One jitted
+        closure serves every pow-2 width bucket (jit re-traces per
+        bucket; warmup pre-observes and pre-compiles them)."""
         fn = self._prefill_cache.get("spec")
         if fn is None:
             verify_fn = self._spec_verify_fn
@@ -3032,47 +3128,106 @@ class Engine:
                 and not self._native_verify
             if paged:
                 from ..ops.paged_kv import gather_view, scatter_decode
+            if self._native_verify:
+                from ..ops.paged_kv import pool_move_rows
+            max_seq = self.config.max_seq
 
-            def _accept_and_bonus(logits, tokens, chunk_lens, step,
-                                  temps, top_ps, top_ks, rng_key):
-                s_width = tokens.shape[1]
-                pred = jnp.argmax(logits, axis=-1)        # [B, S]
-                # draft i (tokens[:, i+1]) is accepted iff it equals
-                # the greedy prediction at position i, and every
-                # earlier draft was accepted
-                drafts = chunk_lens - 1                    # [B]
-                matches = (pred[:, :-1] == tokens[:, 1:]) & \
-                    (jnp.arange(s_width - 1)[None, :] < drafts[:, None])
-                accepted = jnp.cumprod(
-                    matches.astype(jnp.int32), axis=1).sum(axis=1)
+            def _resolve_tree(logits, tokens, parents, depths,
+                              chunk_lens, step, temps, top_ps, top_ks,
+                              rng_key):
+                b, w = tokens.shape
+                pred = jnp.argmax(logits, axis=-1)         # [B, W]
+                # node j is accepted iff its parent is accepted and
+                # its token equals the parent's greedy prediction;
+                # node 0 (the committed root) always is. Topological
+                # packing (parents[j] < j) makes one forward sweep
+                # over the static width exact.
+                acc = jnp.zeros((b, w), bool).at[:, 0].set(True)
+                for j in range(1, w):
+                    pj = parents[:, j:j + 1]               # [B, 1]
+                    p_acc = jnp.take_along_axis(acc, pj, axis=1)[:, 0]
+                    p_pred = jnp.take_along_axis(pred, pj, axis=1)[:, 0]
+                    ok = p_acc & (tokens[:, j] == p_pred) \
+                        & (j < chunk_lens)
+                    acc = acc.at[:, j].set(ok)
+                # deepest accepted node; argmax ties break to the
+                # LOWEST node index = the earliest-proposed chain
+                score = jnp.where(acc, depths, -1)
+                best = jnp.argmax(score, axis=1).astype(jnp.int32)
+                n_acc = jnp.take_along_axis(
+                    depths, best[:, None], axis=1)[:, 0]
+                # root-first path-by-depth: walk parents w static
+                # steps from best, scattering each visited node index
+                # at its own depth (the walk idles at the root once it
+                # arrives — rewrites of path[:, 0] with 0 are no-ops)
+                path = jnp.zeros((b, w), jnp.int32)
+                cur = best
+                for _ in range(w):
+                    d_cur = jnp.take_along_axis(
+                        depths, cur[:, None], axis=1)      # [B, 1]
+                    hit = jnp.arange(w)[None, :] == d_cur
+                    path = jnp.where(hit, cur[:, None], path)
+                    cur = jnp.take_along_axis(
+                        parents, cur[:, None], axis=1)[:, 0]
                 bonus_logits = jnp.take_along_axis(
-                    logits, accepted[:, None, None], axis=1)[:, 0]
+                    logits, best[:, None, None], axis=1)[:, 0]
                 key = jax.random.fold_in(rng_key, step)
                 bonus = _sample_batch(bonus_logits, key, temps,
                                       top_ps, top_ks)
-                return accepted, bonus
+                return n_acc, bonus, path
+
+            def _path_moves(offsets, path, n_acc, w):
+                # KV compaction plan: the accepted node at depth k was
+                # written at row offsets + path[k] and belongs at
+                # offsets + k. k = 0 is an in-place no-op (path[0] is
+                # the root); k > n_acc rows get an out-of-bounds dst
+                # and drop. Inactive slots (offsets = max_seq) drop
+                # everything the same way.
+                k_arange = jnp.arange(w, dtype=jnp.int32)[None, :]
+                src = offsets[:, None] + path              # [B, W]
+                dst = jnp.where(k_arange <= n_acc[:, None],
+                                offsets[:, None] + k_arange, max_seq)
+                return src, dst
+
+            def _move_rows_dense(cache, src, dst):
+                # gather ALL src rows, then scatter — overlap-safe
+                # compaction on [L, B, S, H, D] caches; OOB dst drops
+                s = cache.shape[2]
+                src_c = jnp.clip(src, 0, s - 1)
+                rows = jnp.take_along_axis(
+                    cache, src_c[None, :, :, None, None], axis=2)
+                bidx = jnp.arange(cache.shape[1])[:, None]
+                return cache.at[:, bidx, dst].set(rows, mode="drop")
 
             if self._native_verify:
-                # native paged verify: the model writes the fed rows
-                # through the tables and attends with the ragged chunk
-                # kernel — verify reads only the pages each row's
-                # history + draft window spans, no dense view
+                # native paged verify: the model writes the fed node
+                # rows through the tables and attends with the ragged
+                # tree kernel — verify reads only the pages each row's
+                # history + tree window spans, no dense view; the
+                # accepted path compacts by moving RAW pool rows
+                # (quantized pools move codes+scales untouched, so the
+                # commit is exact — no requantization)
                 native_verify = self._paged_verify_fn
 
-                def fused(params, tokens, kc, vc, tables, offsets,
-                          chunk_lens, step, temps, top_ps, top_ks,
-                          rng_key):
+                def fused(params, tokens, parents, depths, tree_masks,
+                          kc, vc, tables, offsets, chunk_lens, step,
+                          temps, top_ps, top_ks, rng_key):
                     logits, kc, vc = native_verify(
                         params, tokens, kc, vc, tables, offsets,
-                        chunk_lens)
-                    accepted, bonus = _accept_and_bonus(
-                        logits, tokens, chunk_lens, step, temps,
-                        top_ps, top_ks, rng_key)
-                    return accepted, bonus, kc, vc
+                        chunk_lens, tree_depths=depths,
+                        tree_masks=tree_masks)
+                    n_acc, bonus, path = _resolve_tree(
+                        logits, tokens, parents, depths, chunk_lens,
+                        step, temps, top_ps, top_ks, rng_key)
+                    src, dst = _path_moves(offsets, path, n_acc,
+                                           tokens.shape[1])
+                    kc = pool_move_rows(kc, tables, src, dst)
+                    vc = pool_move_rows(vc, tables, src, dst)
+                    return n_acc, bonus, path, kc, vc
             elif paged:
-                def fused(params, tokens, kc, vc, tables, offsets,
-                          chunk_lens, step, temps, top_ps, top_ks,
-                          rng_key):
+                def fused(params, tokens, parents, depths, tree_masks,
+                          kc, vc, tables, offsets, chunk_lens, step,
+                          temps, top_ps, top_ks, rng_key):
                     s_width = tokens.shape[1]
                     k_view = gather_view(kc, tables,
                                          dtype=self._kv_view_dtype)
@@ -3080,51 +3235,102 @@ class Engine:
                                          dtype=self._kv_view_dtype)
                     logits, k_view, v_view = verify_fn(
                         params, tokens, k_view, v_view, offsets,
-                        chunk_lens)
+                        chunk_lens, tree_depths=depths,
+                        tree_masks=tree_masks)
+                    n_acc, bonus, path = _resolve_tree(
+                        logits, tokens, parents, depths, chunk_lens,
+                        step, temps, top_ps, top_ks, rng_key)
+                    src, dst = _path_moves(offsets, path, n_acc,
+                                           s_width)
+                    k_view = _move_rows_dense(k_view, src, dst)
+                    v_view = _move_rows_dense(v_view, src, dst)
                     kc = scatter_decode(kc, tables, k_view,
                                         offsets, s_width)
                     vc = scatter_decode(vc, tables, v_view,
                                         offsets, s_width)
-                    accepted, bonus = _accept_and_bonus(
-                        logits, tokens, chunk_lens, step, temps,
-                        top_ps, top_ks, rng_key)
-                    return accepted, bonus, kc, vc
+                    return n_acc, bonus, path, kc, vc
             else:
-                def fused(params, tokens, kc, vc, offsets, chunk_lens,
-                          step, temps, top_ps, top_ks, rng_key):
-                    logits, kc, vc = verify_fn(params, tokens, kc, vc,
-                                               offsets, chunk_lens)
-                    accepted, bonus = _accept_and_bonus(
-                        logits, tokens, chunk_lens, step, temps,
-                        top_ps, top_ks, rng_key)
-                    return accepted, bonus, kc, vc
-            fn = jax.jit(fused, donate_argnums=(2, 3))
+                def fused(params, tokens, parents, depths, tree_masks,
+                          kc, vc, offsets, chunk_lens, step, temps,
+                          top_ps, top_ks, rng_key):
+                    logits, kc, vc = verify_fn(
+                        params, tokens, kc, vc, offsets, chunk_lens,
+                        tree_depths=depths, tree_masks=tree_masks)
+                    n_acc, bonus, path = _resolve_tree(
+                        logits, tokens, parents, depths, chunk_lens,
+                        step, temps, top_ps, top_ks, rng_key)
+                    src, dst = _path_moves(offsets, path, n_acc,
+                                           tokens.shape[1])
+                    kc = _move_rows_dense(kc, src, dst)
+                    vc = _move_rows_dense(vc, src, dst)
+                    return n_acc, bonus, path, kc, vc
+            fn = jax.jit(fused, donate_argnums=(5, 6))
             self._prefill_cache["spec"] = fn
         return fn
 
-    def _draft_proposals(self, req: GenRequest) -> list[int]:
-        """Prompt-lookup drafting: match the last n-gram of the
-        context against its own history; propose the continuation of
-        the most recent earlier occurrence."""
+    @hot_path_boundary(
+        "drafting policy: O(1)-amortized n-gram index maintenance plus "
+        "controller pricing, host work that runs only for greedy slots "
+        "on a speculation pass — never inside the plain decode pass")
+    def _draft_proposals(self, req: GenRequest):
+        """Prompt-lookup drafting on the request's incremental n-gram
+        index: the stream's final n-gram proposes up to
+        ``spec_branches`` distinct continuations (newest occurrences
+        first), trie-merged into one :class:`DraftTree`. The
+        controller prices each slot's depth/branching per pass; a
+        (0, 0) plan skips drafting entirely. Returns a DraftTree with
+        at least one draft node, or [] when this pass shouldn't
+        draft. The index replaces the old per-pass O(context) rescan
+        with O(new tokens) maintenance + O(branches) dict probes."""
         cfg = self.config
-        n = max(1, cfg.spec_ngram)
-        context = req.prompt_tokens + req.generated
-        if len(context) <= n:
+        slot = req.slot
+        ctrl = self._spec_ctrl
+        if 0 <= slot < ctrl.max_batch:
+            if self._spec_ctrl_owner[slot] is not req:
+                # new tenant in this slot: its predecessor's
+                # accept-rate history doesn't transfer
+                ctrl.reset_slot(slot)
+                self._spec_ctrl_owner[slot] = req
+            depth, branches = ctrl.plan(slot)
+        else:
+            depth, branches = cfg.spec_draft, cfg.spec_branches
+        # never draft past the token budget: the bonus token always
+        # lands, so at most remaining-1 drafts can be kept
+        remaining = req.params.max_new_tokens - len(req.generated)
+        depth = min(depth, max(0, remaining - 1))
+        if depth <= 0 or branches <= 0:
             return []
-        tail = context[-n:]
-        # scan recent history (bounded), newest match first
-        start = max(0, len(context) - n - 512)
-        for pos in range(len(context) - n - 1, start - 1, -1):
-            if context[pos:pos + n] == tail:
-                continuation = context[pos + n:pos + n + cfg.spec_draft]
-                remaining = req.params.max_new_tokens - len(req.generated)
-                return continuation[:max(0, remaining - 1)]
-        return []
+        n = max(1, cfg.spec_ngram)
+        idx = req.spec_index
+        if (idx is None or idx.n != n
+                or idx.prompt_len != len(req.prompt_tokens)):
+            # first drafting pass — or the token stream was rewritten
+            # under the index (preemption/recovery fold generated
+            # tokens back into the prompt): rebuild from scratch
+            idx = NgramIndex(n)
+            idx.extend(req.prompt_tokens)
+            idx.prompt_len = len(req.prompt_tokens)
+            req.spec_index = idx
+        stream_len = idx.prompt_len + len(req.generated)
+        if idx.size < stream_len:
+            idx.extend(req.generated[idx.size - idx.prompt_len:])
+        chains = idx.propose(depth, branches)
+        if not chains:
+            return []
+        tree = build_draft_tree(
+            req.generated[-1], chains,
+            max_nodes=1 + cfg.spec_draft * cfg.spec_branches)
+        return tree if tree.n_draft else []
 
-    def _spec_pass(self, proposals: dict[int, list[int]]) -> None:
-        """One speculative verify pass over every active slot. Slots
-        without drafts ride along with D=0 — for them this is exactly
-        a single decode step."""
+    @hot_path_boundary(
+        "speculative verify collect: the accept/path/bonus download IS "
+        "the pass's sanctioned device sync, and the controller/ledger "
+        "bookkeeping is priced against the multi-token verify pass it "
+        "rides, not per decode pass")
+    def _spec_pass(self, proposals: dict) -> None:
+        """One speculative tree-verify pass over every active slot.
+        Slots without drafts ride along as a lone root node — for
+        them this is exactly a single decode step."""
         cfg = self.config
         paged = cfg.kv_layout == "paged"
         # verify feeds each row's true last token from host state and
@@ -3136,9 +3342,30 @@ class Engine:
         self._dev_last = None
         self._sched_dirty = True
         self._retire_unservable()
-        width = cfg.spec_draft + 1
         b = cfg.max_batch
+        # normalize: monkeypatched _draft_proposals hooks may return a
+        # plain token list (the historical single-chain shape)
+        trees: dict[int, DraftTree] = {}
+        for i, drafted in proposals.items():
+            req = self.active[i]
+            if req is None or req.pending_prefill:
+                continue
+            if not isinstance(drafted, DraftTree):
+                drafted = DraftTree.from_chain(req.generated[-1],
+                                               drafted)
+            if drafted.n_draft:
+                trees[i] = drafted
+        # pow-2 width buckets: the widest tree this pass picks the
+        # verify graph, so the compiled-shape set stays small and
+        # warmup can observe/compile every bucket up front
+        widest = max((t.n_nodes for t in trees.values()), default=1)
+        width = 2
+        while width < widest:
+            width *= 2
         tokens = np.zeros((b, width), np.int32)
+        parents = np.zeros((b, width), np.int32)
+        depths = np.zeros((b, width), np.int32)
+        masks = np.ones((b, width), np.int32)
         chunk_lens = np.ones(b, np.int32)
         offsets = np.full(b, cfg.max_seq, np.int32)  # inactive: drop
         temps = np.zeros(b, np.float32)
@@ -3148,11 +3375,15 @@ class Engine:
         for i, req in enumerate(self.active):
             if req is None or req.pending_prefill:
                 continue
-            drafts = proposals.get(i, [])
             tokens[i, 0] = req.generated[-1]
-            for j, tok in enumerate(drafts):
-                tokens[i, 1 + j] = tok
-            chunk_lens[i] = 1 + len(drafts)
+            tree = trees.get(i)
+            if tree is not None:
+                n = tree.n_nodes
+                tokens[i, :n] = tree.tokens
+                parents[i, :n] = tree.parents
+                depths[i, :n] = tree.depths
+                masks[i, :n] = tree.masks
+                chunk_lens[i] = n
             offsets[i] = int(self.lengths[i])
             temps[i] = req.params.temperature
             top_ps[i] = req.params.top_p
@@ -3161,13 +3392,13 @@ class Engine:
         if not rows:
             return
         if paged:
-            # headroom for every fed row (drafts write cache rows too);
-            # an earlier row's headroom may preempt a later one
+            # headroom for every fed row (draft nodes write cache rows
+            # too); an earlier row's headroom may preempt a later one
             for i in list(rows):
                 if self.active[i] is None:  # preempted as a victim
                     continue
-                rows_needed = min(int(self.lengths[i]) + width,
-                                  cfg.max_seq)
+                rows_needed = min(int(self.lengths[i])
+                                  + int(chunk_lens[i]), cfg.max_seq)
                 if not self._ensure_headroom(i, rows_needed):
                     self._preempt(i)
         tables = (self._tables_arg(),) if paged else ()
@@ -3177,14 +3408,17 @@ class Engine:
         self.goodput.note_dispatch(start)
         w0 = time.time()
         fn = self._get_spec_verify()
-        accepted_dev, bonus_dev, self.k_cache, self.v_cache = fn(
-            self.params, jnp.asarray(tokens), self.k_cache,
-            self.v_cache, *tables, jnp.asarray(offsets),
-            jnp.asarray(chunk_lens), np.int32(self._rng_step),
-            jnp.asarray(temps), jnp.asarray(top_ps),
-            jnp.asarray(top_ks), self._prefill_base_key)
+        accepted_dev, bonus_dev, path_dev, self.k_cache, \
+            self.v_cache = fn(
+                self.params, jnp.asarray(tokens), jnp.asarray(parents),
+                jnp.asarray(depths), jnp.asarray(masks), self.k_cache,
+                self.v_cache, *tables, jnp.asarray(offsets),
+                jnp.asarray(chunk_lens), np.int32(self._rng_step),
+                jnp.asarray(temps), jnp.asarray(top_ps),
+                jnp.asarray(top_ks), self._prefill_base_key)
         accepted = np.asarray(accepted_dev)
         bonus = np.asarray(bonus_dev)
+        path = np.asarray(path_dev)
         if self._native_verify:
             self._note_view_avoided(b)
         self._note_pass("spec_passes", start)
@@ -3199,15 +3433,16 @@ class Engine:
             if req is None or req.pending_prefill:
                 continue
             req.device_s += verify_share
-            n_acc = int(accepted[i])
-            n_drafted = len(proposals.get(i, []))
+            tree = trees.get(i)
+            n_drafted = tree.n_draft if tree is not None else 0
+            n_acc = min(int(accepted[i]), n_drafted)
             if n_drafted:
                 # the rejected-draft slice of this row's device time:
                 # positions computed and thrown away, billed to the
                 # tenant that drafted them
                 req.waste_spec_s += verify_share \
-                    * (n_drafted - min(n_acc, n_drafted)) \
-                    / (1 + n_drafted)
+                    * (n_drafted - n_acc) / (1 + n_drafted)
+                self._spec_ctrl.note_result(i, n_drafted, n_acc)
             row_stats.append((n_drafted, n_acc))
             pass_drafted += n_drafted
             pass_accepted += n_acc
@@ -3216,14 +3451,18 @@ class Engine:
                 self._req_event(req, "spec_verify", w0, w1,
                                 {"drafted": n_drafted,
                                  "accepted": n_acc})
-            emitted = proposals.get(i, [])[:n_acc] + [int(bonus[i])]
+            # the accepted root-to-leaf path's tokens, in depth order,
+            # then the bonus sampled at the deepest accepted node
+            emitted = [tree.tokens[int(path[i, k])]
+                       for k in range(1, n_acc + 1)] if tree else []
+            emitted.append(int(bonus[i]))
             self.stats["spec_accepted"] += n_acc
             # offered drafts this row — the honest acceptance-rate
             # denominator (spec_passes counts batched passes, so
             # accepted/passes*draft overstates with G rows per pass);
             # spec_rows counts row-participations: each emits exactly
             # one bonus token, the per-row tokens-per-verify base
-            self.stats["spec_drafted"] += len(proposals.get(i, []))
+            self.stats["spec_drafted"] += n_drafted
             self.stats["spec_rows"] += 1
             # rows for the fed tokens were written at offsets..; only
             # the accepted prefix (plus the already-cached last token)
@@ -3252,6 +3491,9 @@ class Engine:
             self.metrics.add_counter("app_engine_spec_accepted",
                                      float(pass_accepted))
         self.goodput.add_spec(spec_dur, b, row_stats)
+        # fit the controller's verify row cost from the same span the
+        # ledger bills, so policy and waste accounting can't diverge
+        self._spec_ctrl.note_verify(spec_dur, pass_rows, width)
         self._update_kv_watermarks()
         if self.recorder.enabled:
             self.recorder.record_pass(
@@ -3306,6 +3548,7 @@ class Engine:
         return {"goodput": self.goodput.state(),
                 "watermarks": self.watermarks.state(),
                 "recompiles": self.sentinel.state(),
+                "spec": self._spec_ctrl.state(),
                 "kv_bytes": self._kv_bytes_total,
                 "kv_bytes_per_token": round(
                     self._kv_bytes_total / max(1, cap_tokens), 3)}
@@ -3358,6 +3601,9 @@ class Engine:
         mfu = (tps * self._flops_per_token / self._peak_flops
                if self._flops_per_token and self._peak_flops else 0.0)
         m.set_gauge("app_engine_mfu", round(mfu, 6))
+        if self._spec_enabled:
+            m.set_gauge("app_engine_spec_accept_rate",
+                        round(self._spec_ctrl.accept_rate(), 6))
         if hasattr(self.waiting, "publish_gauges"):
             self.waiting.publish_gauges(m)
         cfg = self.config
@@ -3481,7 +3727,7 @@ class Engine:
                         if live:
                             self._admit_batch(live)
                 if any(r is not None for r in self.active):
-                    proposals: dict[int, list[int]] = {}
+                    proposals: dict[int, Any] = {}  # slot -> DraftTree
                     decoding = 0
                     if self._spec_enabled:
                         for i, r in enumerate(self.active):

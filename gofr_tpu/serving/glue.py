@@ -109,10 +109,11 @@ def llama_engine(params: Any, model_config: LlamaConfig,
         return logits, kc, vc
 
     def spec_verify_fn(params, tokens, k_cache, v_cache, offsets,
-                       chunk_lengths):
+                       chunk_lengths, tree_depths=None, tree_masks=None):
         logits, kc, vc = llama_prefill_chunk(
             params, tokens, k_cache, v_cache, offsets, chunk_lengths, c,
-            implementation=implementation, return_all_logits=True)
+            implementation=implementation, return_all_logits=True,
+            tree_depths=tree_depths, tree_masks=tree_masks)
         if constrain_kv is not None:
             kc, vc = constrain_kv(kc), constrain_kv(vc)
         return logits, kc, vc
@@ -168,11 +169,13 @@ def llama_engine(params: Any, model_config: LlamaConfig,
                 chunk_lengths, c, implementation=impl)
 
         def paged_verify_fn(params, tokens, k_pool, v_pool, tables,
-                            offsets, chunk_lengths):
+                            offsets, chunk_lengths, tree_depths=None,
+                            tree_masks=None):
             return llama_prefill_chunk_paged(
                 params, tokens, k_pool, v_pool, tables, offsets,
                 chunk_lengths, c, implementation=impl,
-                return_all_logits=True)
+                return_all_logits=True, tree_depths=tree_depths,
+                tree_masks=tree_masks)
 
     return Engine(params, engine_config, prefill_fn=prefill_fn,
                   decode_fn=decode_fn, make_cache=make_cache,

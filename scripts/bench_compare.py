@@ -204,6 +204,24 @@ def self_test() -> int:
                                   kv_tok_per_s_int8=5000.0)),
           entry(2.0, kv_capacity_ratio=1.2,
                 kv_tok_per_s_int8=2000.0)], 0),
+        # spec_* speculation diagnostics are report-only: accept rates
+        # and pass-efficiency ratios are workload properties (the
+        # bench asserts its own floors in-run), so even a collapsed
+        # accept rate or halved pass-efficiency must never gate
+        ("spec diagnostics drop reports but never gates",
+         [dict(base, metrics=dict(base["metrics"],
+                                  spec_tok_per_pass_ratio=1.8,
+                                  spec_accept_rate_rep=0.9,
+                                  spec_accept_rate_low=0.3,
+                                  spec_adaptive_regression=1.0,
+                                  spec_waste_static_s=0.01,
+                                  spec_waste_adaptive_s=0.001)),
+          entry(2.0, spec_tok_per_pass_ratio=0.9,
+                spec_accept_rate_rep=0.1,
+                spec_accept_rate_low=0.05,
+                spec_adaptive_regression=0.5,
+                spec_waste_static_s=0.2,
+                spec_waste_adaptive_s=0.1)], 0),
     ]
     failed = 0
     for name, entries, want in checks:

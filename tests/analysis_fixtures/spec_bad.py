@@ -1,0 +1,25 @@
+"""spec drafting/controller contract: violations. Lines matter —
+test_analysis.py pins them."""
+import time
+
+import numpy as np
+
+from gofr_tpu.analysis import hot_path
+
+
+class Engine:
+    @hot_path
+    def decode_pass(self, state, logits):
+        drafts = self._draft(state)           # closure reaches _draft
+        t0 = time.time()                      # L14: wall clock inline
+        self.metrics.add_counter("app_engine_spec_drafted", 1.0)  # L15
+        self.logger.info("drafted")           # L16: logging inline
+        return drafts, t0
+
+    def _draft(self, state):
+        # undecorated drafting helper reached from the hot root: the
+        # per-pass context rescan's device read and the controller's
+        # wall-clock pricing must flag
+        host = np.asarray(state)              # L23: d2h sync
+        started = time.time()                 # L24: wall clock
+        return list(host), started

@@ -1,0 +1,21 @@
+"""spec drafting/controller contract: the clean twin — none of this
+may be flagged."""
+import time
+
+from gofr_tpu.analysis import hot_path, hot_path_boundary
+
+
+class Engine:
+    @hot_path
+    def decode_pass(self, state):
+        # the hot loop only DECIDES to speculate; everything hosty
+        # lives behind the drafting boundary, where the walk stops
+        return self._draft_proposals(state)
+
+    @hot_path_boundary(
+        "drafting policy is host work priced against the multi-token "
+        "verify pass it gates, not paid per decode pass")
+    def _draft_proposals(self, state):
+        self.metrics.add_counter("app_engine_spec_drafted", 1.0)
+        self.logger.info("drafting")
+        return time.time()

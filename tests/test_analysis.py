@@ -158,6 +158,47 @@ class TestEventLedgerContract:
         assert not offenders, offenders
 
 
+# ------------------------------------------------ speculation contract
+class TestSpeculationContract:
+    """The drafting/controller contract, lint-enforced: n-gram index
+    maintenance, controller pricing and the verify collect's device
+    reads are legal ONLY behind the engine's @hot_path_boundary entry
+    points (``_draft_proposals``, ``_spec_pass``) — inline in a hot
+    root, or in an undecorated helper the closure reaches, they must
+    flag."""
+
+    def test_inline_drafting_flags(self):
+        got = violations(lint("spec_bad.py"), "hot-path-purity")
+        lines = {f.line for f in got}
+        assert {14, 15, 16} <= lines    # clock + counter + log inline
+        assert {23, 24} <= lines        # closure-reached draft helper
+
+    def test_boundary_drafting_is_clean(self):
+        assert violations(lint("spec_good.py"), "hot-path-purity") == []
+
+    def test_live_spec_entry_points_declare_boundaries(self):
+        # the real module, not a fixture: drafting and the verify
+        # collect must keep their boundaries (with reasons) or the
+        # n-gram index, controller EWMAs and accept/path downloads
+        # would drag host syncs into the engine's hot closure
+        from gofr_tpu.serving.engine import Engine
+        for entry in (Engine._draft_proposals, Engine._spec_pass):
+            reason = getattr(entry, "__gofr_hot_path_boundary__", "")
+            assert isinstance(reason, str) and reason.strip(), entry
+
+    def test_live_repo_hot_closure_excludes_spec(self):
+        # the drafting/controller module stays out of the hot closure:
+        # it is only reachable through the declared boundary sites
+        from gofr_tpu.analysis.callgraph import CallGraph
+        from gofr_tpu.analysis.core import load_project
+        project = load_project([REPO / "gofr_tpu" / "serving"],
+                               root=REPO)
+        closure = CallGraph(project).hot_closure()
+        offenders = [str(k) for k in closure
+                     if k.module.endswith("spec.py")]
+        assert not offenders, offenders
+
+
 # ----------------------------------------------------- router contract
 class TestRouterContract:
     """The serving/router.py contract, lint-enforced: the async proxy

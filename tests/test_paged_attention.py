@@ -241,12 +241,13 @@ def test_int8_decode_kernel_matches_int8_xla(page, hq, hkv):
     got = paged_decode_attention_pallas(q, kq, vq, tables, lens,
                                         interpret=True)
     want = paged_decode_attention_xla(q, kq, vq, tables, lens)
-    # compare valid slots only: the fallback's zero-length output is
-    # unmasked garbage by (pre-existing) contract, the kernel's is 0
-    np.testing.assert_allclose(np.asarray(got)[:2], np.asarray(want)[:2],
+    # full-batch comparison: the fallback masks zero-length slots to
+    # exact zeros, matching the kernel's denom-clamp contract
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
     assert not np.isnan(np.asarray(got)).any()
     np.testing.assert_allclose(np.asarray(got)[2], 0.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(want)[2], 0.0, atol=1e-6)
 
 
 @pytest.mark.parametrize("page", [8, 16])
@@ -300,6 +301,9 @@ def test_int8_chunk_kernel_matches_int8_xla(page, hq, hkv):
         np.testing.assert_allclose(np.asarray(got)[i, :n],
                                    np.asarray(want)[i, :n],
                                    rtol=2e-5, atol=2e-5)
+    # the zero-length tail slot (history == chunk == 0) is exact zeros
+    # on BOTH paths now — the fallback masks it like the kernel
+    np.testing.assert_allclose(np.asarray(want)[2], 0.0, atol=1e-6)
 
 
 def test_int8_chunk_within_quant_bound_of_f32():
@@ -446,3 +450,180 @@ def test_prefill_spans_do_not_double_count():
     wall = _t.perf_counter() - t0
     assert engine.stats["prefill_s"] <= wall + 0.01
     engine._shutdown_cleanup("test over")
+
+
+# ------------------------------------------------------ tree verify
+#
+# Multi-draft tree verify (ops/paged_attention.py paged_tree_attention):
+# Sq tree nodes per slot attend the full history plus exactly their
+# packed-ancestor in-tree rows. Parity cases mirror the serving shapes:
+# branch counts 1/2/4, histories starting mid-page, a zero-length tail
+# slot, GQA groups 1 and 4, f32/bf16/int8 pools. A chain-shaped tree
+# must reduce bit-for-bit to the causal chunk kernel — speculation's
+# greedy-identity contract rides on that.
+
+from gofr_tpu.ops.attention import tree_attention
+from gofr_tpu.ops.paged_attention import (paged_tree_attention,
+                                          paged_tree_attention_pallas,
+                                          paged_tree_attention_xla)
+from gofr_tpu.serving.spec import build_draft_tree
+
+
+def _branch_chains(branches):
+    if branches == 1:
+        return [[1, 2, 3, 4]]
+    if branches == 2:
+        return [[1, 2, 3], [1, 5], [6, 7]]  # shared prefix + fork
+    return [[1, 2], [3, 4], [5, 6], [7, 8]]
+
+
+def _tree_case(seed, *, branches, hq, hkv, page=8, dtype=jnp.float32):
+    """3 slots: mid-page histories (3, 9) and a zero-length tail; the
+    2nd slot verifies a topological PREFIX of the tree (shorter
+    chunk), the 3rd is inactive."""
+    tree = build_draft_tree(0, _branch_chains(branches))
+    sq = tree.n_nodes
+    b, hd, max_pages, n_pages = 3, 16, 8, 32
+    ks = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(ks[0], (b, sq, hq, hd), jnp.float32)
+    k_pool = jax.random.normal(ks[1], (hkv, n_pages, page, hd),
+                               jnp.float32).astype(dtype)
+    v_pool = jax.random.normal(ks[2], (hkv, n_pages, page, hd),
+                               jnp.float32).astype(dtype)
+    history = jnp.asarray([3, 9, 0], jnp.int32)
+    chunk_lens = jnp.asarray([sq, min(sq, 3), 0], jnp.int32)
+    masks = np.ones((b, sq), np.int32)
+    masks[0] = tree.masks
+    masks[1, :sq] = tree.masks  # prefix rows are the ones compared
+    rng = np.random.default_rng(seed)
+    tables = np.full((b, max_pages), n_pages, np.int32)
+    for i in range(b):
+        need = -(-int(history[i] + chunk_lens[i]) // page)
+        if need:
+            tables[i, :need] = rng.choice(n_pages, size=need,
+                                          replace=False)
+    return (q, k_pool, v_pool, jnp.asarray(tables), history,
+            chunk_lens, jnp.asarray(masks))
+
+
+@pytest.mark.parametrize("branches", [1, 2, 4])
+@pytest.mark.parametrize("hq,hkv", [(4, 4),   # GQA group 1
+                                    (8, 2)])  # GQA group 4
+def test_tree_kernel_matches_xla(branches, hq, hkv):
+    (q, k_pool, v_pool, tables, history, chunk_lens,
+     masks) = _tree_case(61 + branches, branches=branches, hq=hq,
+                         hkv=hkv)
+    got = paged_tree_attention_pallas(q, k_pool, v_pool, tables,
+                                      history, chunk_lens, masks,
+                                      interpret=True)
+    want = paged_tree_attention_xla(q, k_pool, v_pool, tables,
+                                    history, chunk_lens, masks)
+    assert not np.isnan(np.asarray(got)).any()
+    for i in range(3):
+        n = int(chunk_lens[i])  # rows past chunk_len are padding
+        np.testing.assert_allclose(np.asarray(got)[i, :n],
+                                   np.asarray(want)[i, :n],
+                                   rtol=2e-5, atol=2e-5)
+    # the zero-length tail slot returns exact zeros on both paths
+    np.testing.assert_allclose(np.asarray(got)[2], 0.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(want)[2], 0.0, atol=1e-6)
+
+
+def test_tree_chain_reduces_to_causal_chunk():
+    """A chain-shaped tree's ancestor bitmask IS the causal window:
+    the tree kernel must match the chunk kernel on it (speculation's
+    greedy bit-identity rides this)."""
+    (q, k_pool, v_pool, tables, history, chunk_lens,
+     masks) = _tree_case(67, branches=1, hq=8, hkv=2)
+    got = paged_tree_attention_pallas(q, k_pool, v_pool, tables,
+                                      history, chunk_lens, masks,
+                                      interpret=True)
+    want = paged_chunk_attention_pallas(q, k_pool, v_pool, tables,
+                                        history, chunk_lens,
+                                        interpret=True)
+    for i in range(3):
+        n = int(chunk_lens[i])
+        np.testing.assert_allclose(np.asarray(got)[i, :n],
+                                   np.asarray(want)[i, :n],
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_tree_sibling_cannot_see_sibling():
+    """Poisoning a sibling branch's pool rows must not change a node's
+    output — only ancestors are visible in-tree."""
+    (q, k_pool, v_pool, tables, history, chunk_lens,
+     masks) = _tree_case(71, branches=2, hq=4, hkv=4)
+    tree = build_draft_tree(0, _branch_chains(2))
+    clean = paged_tree_attention_pallas(q, k_pool, v_pool, tables,
+                                        history, chunk_lens, masks,
+                                        interpret=True)
+    # poison the LAST node's pool row for slot 0 (a leaf on the other
+    # fork): nodes not descending from it must be unchanged
+    leaf = tree.n_nodes - 1
+    pos = int(history[0]) + leaf
+    pid = int(tables[0, pos // k_pool.shape[2]])
+    poisoned = np.asarray(k_pool).copy()
+    poisoned[:, pid, pos % k_pool.shape[2]] = 1e6
+    got = paged_tree_attention_pallas(q, jnp.asarray(poisoned), v_pool,
+                                      tables, history, chunk_lens,
+                                      masks, interpret=True)
+    unaffected = [i for i in range(tree.n_nodes)
+                  if not (tree.masks[i] >> leaf) & 1]
+    np.testing.assert_allclose(np.asarray(got)[0, unaffected],
+                               np.asarray(clean)[0, unaffected],
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("branches", [2, 4])
+def test_int8_tree_kernel_matches_int8_xla(branches):
+    (q, k_pool, v_pool, tables, history, chunk_lens,
+     masks) = _tree_case(73 + branches, branches=branches, hq=8, hkv=2)
+    kq, vq = quantize_pool(k_pool), quantize_pool(v_pool)
+    got = paged_tree_attention_pallas(q, kq, vq, tables, history,
+                                      chunk_lens, masks,
+                                      interpret=True)
+    want = paged_tree_attention_xla(q, kq, vq, tables, history,
+                                    chunk_lens, masks)
+    assert not np.isnan(np.asarray(got)).any()
+    for i in range(3):
+        n = int(chunk_lens[i])
+        np.testing.assert_allclose(np.asarray(got)[i, :n],
+                                   np.asarray(want)[i, :n],
+                                   rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got)[2], 0.0, atol=1e-6)
+
+
+def test_bf16_tree_pools_within_cast_bound():
+    (q, k_pool, v_pool, tables, history, chunk_lens,
+     masks) = _tree_case(79, branches=2, hq=4, hkv=4,
+                         dtype=jnp.bfloat16)
+    got = paged_tree_attention_pallas(q, k_pool, v_pool, tables,
+                                      history, chunk_lens, masks,
+                                      interpret=True)
+    want = paged_tree_attention_xla(q, k_pool, v_pool, tables,
+                                    history, chunk_lens, masks)
+    for i in range(3):
+        n = int(chunk_lens[i])
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32)[i, :n],
+            np.asarray(want, np.float32)[i, :n], atol=2e-2)
+
+
+def test_tree_dispatch_auto_on_cpu_matches_dense():
+    (q, k_pool, v_pool, tables, history, chunk_lens,
+     masks) = _tree_case(83, branches=2, hq=8, hkv=2)
+    got = paged_tree_attention(q, k_pool, v_pool, tables, history,
+                               chunk_lens, masks,
+                               implementation="auto")
+    safe = jnp.minimum(tables, k_pool.shape[1] - 1)
+    k_dense = k_pool[:, safe].transpose(1, 2, 3, 0, 4).reshape(
+        3, -1, k_pool.shape[0], k_pool.shape[3])
+    v_dense = v_pool[:, safe].transpose(1, 2, 3, 0, 4).reshape(
+        3, -1, v_pool.shape[0], v_pool.shape[3])
+    want = tree_attention(q, k_dense, v_dense, history_lens=history,
+                          chunk_lens=chunk_lens, tree_masks=masks)
+    for i in range(3):
+        n = int(chunk_lens[i])
+        np.testing.assert_allclose(np.asarray(got)[i, :n],
+                                   np.asarray(want)[i, :n],
+                                   rtol=2e-5, atol=2e-5)

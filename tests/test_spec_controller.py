@@ -1,0 +1,325 @@
+"""Speculation policy layer (serving/spec.py): draft trees, the
+incremental n-gram index, the goodput-priced controller — and the
+engine integration points that keep controller/index state honest
+across preemption, restart and recovery."""
+
+import numpy as np
+import pytest
+
+from gofr_tpu.serving.spec import (MAX_TREE_NODES, DraftTree, NgramIndex,
+                                   SpecController, build_draft_tree)
+
+
+# ------------------------------------------------------------ DraftTree
+class TestDraftTree:
+    def test_topological_packing_and_masks(self):
+        t = DraftTree.root(7)
+        a = t.add(0, 1)
+        b = t.add(a, 2)
+        c = t.add(0, 3)          # sibling fork off the root
+        assert t.parents == [0, 0, a, 0]
+        assert t.depths == [0, 1, 2, 1]
+        for i in range(1, t.n_nodes):
+            assert t.parents[i] < i  # parent index < child index
+        # masks: ancestor-or-self bits over the node index
+        assert t.masks[0] == 0b0001
+        assert t.masks[a] == 0b0011
+        assert t.masks[b] == 0b0111
+        assert t.masks[c] == 0b1001  # root + itself, NOT the other fork
+        assert t.path_to(b) == [0, a, b]
+        assert t.path_to(c) == [0, c]
+
+    def test_from_chain_is_the_historical_shape(self):
+        t = DraftTree.from_chain(9, [4, 5, 6])
+        assert t.tokens == [9, 4, 5, 6]
+        assert t.parents == [0, 0, 1, 2]
+        assert t.depths == [0, 1, 2, 3]
+        # a chain's masks are exactly the causal window
+        assert t.masks == [0b1, 0b11, 0b111, 0b1111]
+
+    def test_capacity_is_the_bitmask_width(self):
+        t = DraftTree.root(0)
+        for i in range(MAX_TREE_NODES - 1):
+            t.add(0, i)
+        with pytest.raises(ValueError, match="exceeds"):
+            t.add(0, 99)
+
+    def test_trie_merge_shares_prefixes(self):
+        t = build_draft_tree(0, [[1, 2, 3], [1, 2, 9], [5]])
+        # "1 2" shared: 1 root + 3 + 1 + 1 nodes, not 1 + 3 + 3 + 1
+        assert t.n_nodes == 6
+        assert t.max_depth == 3
+
+    def test_trie_merge_stops_silently_at_cap(self):
+        chains = [[i, i + 100] for i in range(40)]
+        t = build_draft_tree(0, chains, max_nodes=8)
+        assert t.n_nodes == 8
+
+
+# ----------------------------------------------------------- NgramIndex
+class TestNgramIndex:
+    def _naive(self, toks, n, depth, branches):
+        """The old O(context) rescan, generalized to k branches."""
+        if len(toks) < n:
+            return []
+        tail = toks[-n:]
+        out, seen = [], set()
+        for pos in range(len(toks) - n - 1, -1, -1):
+            if toks[pos:pos + n] == tail:
+                cont = toks[pos + n:pos + n + depth]
+                if not cont or cont[0] in seen:
+                    continue
+                seen.add(cont[0])
+                out.append(cont)
+                if len(out) >= branches:
+                    break
+        return out
+
+    def test_incremental_matches_naive_rescan(self):
+        rng = np.random.RandomState(0)
+        toks = list(rng.randint(0, 6, size=400))  # small alphabet:
+        idx = NgramIndex(3)                       # plenty of repeats
+        idx.extend(toks[:100])
+        for i in range(100, len(toks)):
+            idx.extend([toks[i]])
+            got = idx.propose(4, 2)
+            want = self._naive(toks[:i + 1], 3, 4, 2)
+            assert got == want, i
+
+    def test_skips_the_suffix_own_occurrence(self):
+        idx = NgramIndex(2)
+        idx.extend([1, 2, 3, 1, 2])  # the tail "1 2" occurs at 0 and 3
+        assert idx.propose(2, 2) == [[3, 1]]  # pos 3 has no continuation
+
+    def test_distinct_first_tokens(self):
+        idx = NgramIndex(2)
+        idx.extend([1, 2, 7, 0, 1, 2, 7, 9, 1, 2])
+        chains = idx.propose(3, 4)
+        firsts = [c[0] for c in chains]
+        assert len(firsts) == len(set(firsts)) == 1  # both start 7
+        assert chains[0][0] == 7
+
+    def test_zero_depth_or_branches_proposes_nothing(self):
+        idx = NgramIndex(2)
+        idx.extend([1, 2, 1, 2])
+        assert idx.propose(0, 2) == []
+        assert idx.propose(2, 0) == []
+
+
+# -------------------------------------------------------- SpecController
+def _calibrated(ctrl, *, spt=1e-3, rc=1e-5):
+    ctrl.note_decode(spt * 10, 10)     # sec/token = spt
+    ctrl.note_verify(rc * 20, 4, 5)    # row cost = rc
+    return ctrl
+
+
+class TestSpecController:
+    def test_optimistic_bootstrap_drafts_full_depth(self):
+        ctrl = SpecController(2, draft=4, branches=2)
+        assert ctrl.plan(0) == (4, 2)          # uncalibrated: go fit
+        assert ctrl.accept_rate() == 1.0       # gauge stays in [0, 1]
+
+    def test_cheap_verify_keeps_full_depth(self):
+        ctrl = _calibrated(SpecController(2, draft=4, branches=2))
+        assert ctrl.plan(0) == (4, 2)
+
+    def test_expensive_verify_shrinks_depth(self):
+        # rows nearly as expensive as a decoded token: with a mediocre
+        # accept EWMA only shallow drafts still pay
+        ctrl = _calibrated(SpecController(2, draft=4, branches=2),
+                           spt=1e-3, rc=4e-4)
+        ctrl.accept[0] = 0.85
+        depth, branches = ctrl.plan(0)
+        assert 0 < depth < 4
+        assert branches == 2
+
+    def test_worthless_drafting_plans_zero(self):
+        ctrl = _calibrated(SpecController(2, draft=4, branches=2),
+                           spt=1e-3, rc=9e-4)
+        ctrl.accept[0] = 0.3  # 0.3 * 1e-3 < 2 * 9e-4 already at d=1
+        assert ctrl.plan(0) == (0, 0)
+
+    def test_collapse_disables_then_probe_reenables(self):
+        ctrl = _calibrated(SpecController(1, draft=4, branches=2,
+                                          accept_floor=0.2,
+                                          probe_interval=4))
+        for _ in range(12):                    # EWMA collapses
+            ctrl.note_result(0, 4, 0)
+        assert ctrl.disabled[0]
+        plans = [ctrl.plan(0) for _ in range(4)]
+        assert plans[:3] == [(0, 0)] * 3       # idle until the probe
+        assert plans[3] == (1, 1)              # single-node probe
+        ctrl.note_result(0, 1, 1)              # the probe survives
+        assert not ctrl.disabled[0]
+        assert ctrl.plan(0)[0] > 0
+
+    def test_dead_probe_stays_disabled(self):
+        ctrl = _calibrated(SpecController(1, draft=4, branches=1,
+                                          accept_floor=0.2,
+                                          probe_interval=2))
+        for _ in range(12):
+            ctrl.note_result(0, 4, 0)
+        assert ctrl.disabled[0]
+        ctrl.note_result(0, 1, 0)              # probe dies
+        assert ctrl.disabled[0]
+
+    def test_reset_slot_restores_optimism(self):
+        ctrl = _calibrated(SpecController(1, draft=4, branches=2))
+        for _ in range(12):
+            ctrl.note_result(0, 4, 0)
+        assert ctrl.disabled[0]
+        ctrl.reset_slot(0)
+        assert not ctrl.disabled[0]
+        assert ctrl.accept[0] == 1.0
+        # fitted costs survive a tenant change — prices don't reset
+        assert ctrl.sec_per_token is not None
+        assert ctrl.row_cost is not None
+
+    def test_static_policy_ignores_everything(self):
+        ctrl = _calibrated(SpecController(1, draft=3, branches=2,
+                                          adaptive=False))
+        for _ in range(12):
+            ctrl.note_result(0, 3, 0)
+        assert ctrl.plan(0) == (3, 2)          # never adapts
+
+    def test_state_snapshot_shape(self):
+        ctrl = _calibrated(SpecController(2, draft=4, branches=2))
+        ctrl.note_result(0, 4, 2)
+        s = ctrl.state()
+        assert s["drafted"] == 4 and s["accepted"] == 2
+        assert 0.0 <= s["accept_rate"] <= 1.0
+        assert len(s["slots"]) == 2
+        assert set(s["slots"][0]) == {"accept_ewma", "disabled"}
+
+
+# --------------------------------------------------- engine integration
+from gofr_tpu.serving.engine import EngineConfig, SamplingParams
+from gofr_tpu.serving.glue import demo_llama_engine
+
+PATTERN = [11, 22, 33, 44] * 12
+
+
+def test_engine_rejects_oversized_tree_config():
+    with pytest.raises(ValueError, match="bitmask"):
+        demo_llama_engine(EngineConfig(speculative=True, spec_draft=8,
+                                       spec_branches=4))
+
+
+def test_ngram_index_rebuilds_after_preempt_fold():
+    """Preemption folds generated tokens into the prompt — the
+    incremental index must detect the rewritten stream and rebuild,
+    not extend a stale view of it."""
+    cfg = EngineConfig(max_batch=2, max_seq=128, seed=9,
+                       kv_layout="paged", page_size=16,
+                       prefill_buckets=(64,), speculative=True)
+    engine = demo_llama_engine(cfg)
+    req = engine.submit(PATTERN[:24], SamplingParams(
+        temperature=0.0, max_new_tokens=32))
+    engine._admit_batch([engine.waiting.pop_batch(1)[0]])
+    engine._collect_prefills()
+    assert len(req.generated) == 1
+    engine._draft_proposals(req)
+    idx = req.spec_index
+    assert idx is not None
+    assert idx.prompt_len == 24
+    assert idx.size == 24 + len(req.generated)
+    prompt_before = len(req.prompt_tokens)
+    engine._preempt(req.slot)
+    assert len(req.prompt_tokens) > prompt_before  # generated folded in
+    # re-admit the requeued continuation and draft again
+    batch, engine._requeued = engine._requeued, []
+    engine._requeued_set.clear()
+    engine._admit_batch(batch)
+    engine._collect_prefills()
+    engine._draft_proposals(req)
+    idx2 = req.spec_index
+    assert idx2 is not idx                     # rebuilt, not extended
+    assert idx2.prompt_len == len(req.prompt_tokens)
+    engine._shutdown_cleanup("test over")
+
+
+def test_controller_slot_state_resets_per_tenant_and_restart():
+    """_reset_runtime_state (shared by stop/start and the crash
+    supervisor) voids slot ownership so a re-admitted slot re-seeds
+    its EWMA; fitted prices and lifetime totals survive."""
+    cfg = EngineConfig(max_batch=2, max_seq=128, seed=9,
+                       prefill_buckets=(64,), speculative=True)
+    engine = demo_llama_engine(cfg)
+    ctrl = engine._spec_ctrl
+    ctrl.note_decode(0.01, 10)
+    ctrl.note_verify(0.001, 2, 5)
+    ctrl.note_result(0, 4, 0)
+    ctrl.accept[0] = 0.0
+    ctrl.disabled[0] = True
+    engine._spec_ctrl_owner[0] = object()      # pretend slot 0 is owned
+    engine._reset_runtime_state()
+    assert engine._spec_ctrl_owner == [None, None]
+    # the controller object survives with its fitted costs + ledger
+    assert ctrl.sec_per_token is not None
+    assert ctrl.drafted_total == 4
+    # next tenant in slot 0 resets the slot EWMA through the owner
+    # check in _draft_proposals
+    req = engine.submit(PATTERN, SamplingParams(
+        temperature=0.0, max_new_tokens=8))
+    engine._admit_batch([engine.waiting.pop_batch(1)[0]])
+    engine._collect_prefills()
+    engine._draft_proposals(req)
+    assert not ctrl.disabled[req.slot]
+    assert ctrl.accept[req.slot] == 1.0
+    engine._shutdown_cleanup("test over")
+
+
+def test_adaptive_controller_preserves_greedy_identity():
+    """The controller only decides WHETHER to draft — greedy outputs
+    stay identical to vanilla decode with adaptation on, off, and
+    with multi-branch trees."""
+    import time as _t
+
+    def run(engine, n=20):
+        engine.start()
+        try:
+            req = engine.submit_sync(PATTERN, SamplingParams(
+                temperature=0.0, max_new_tokens=n))
+            assert req.error is None, req.error
+            return list(req.generated), dict(engine.stats)
+        finally:
+            engine.stop()
+
+    base = dict(max_batch=2, max_seq=256, prefill_buckets=(64,), seed=9)
+    vanilla, _ = run(demo_llama_engine(EngineConfig(**base)))
+    for extra in (dict(spec_adaptive=True, spec_branches=2),
+                  dict(spec_adaptive=False, spec_branches=1),
+                  dict(spec_adaptive=False, spec_branches=4,
+                       spec_draft=3)):
+        engine = demo_llama_engine(EngineConfig(speculative=True,
+                                                **base, **extra))
+        got, stats = run(engine)
+        assert got == vanilla, extra
+        assert stats["spec_passes"] > 0
+        state = engine.efficiency_state()["spec"]
+        assert state["drafted"] >= state["accepted"] >= 0
+
+
+def test_disabled_slots_fall_back_to_plain_decode():
+    """A workload the drafter can hit n-grams on but the model never
+    confirms: the controller must disable the slot and the engine
+    must keep decoding plainly (correct tokens, no stall)."""
+    cfg = EngineConfig(max_batch=1, max_seq=256, prefill_buckets=(64,),
+                      seed=9, speculative=True, spec_accept_floor=0.9,
+                      spec_probe_interval=100)
+    engine = demo_llama_engine(cfg)
+    base = EngineConfig(max_batch=1, max_seq=256,
+                        prefill_buckets=(64,), seed=9)
+    vanilla_engine = demo_llama_engine(base)
+
+    def run(e):
+        e.start()
+        try:
+            req = e.submit_sync(PATTERN, SamplingParams(
+                temperature=0.0, max_new_tokens=24))
+            assert req.error is None, req.error
+            return list(req.generated)
+        finally:
+            e.stop()
+
+    assert run(engine) == run(vanilla_engine)
