@@ -135,6 +135,8 @@ def run_scenario(engine_cfg, prompts, gen_len, warm_lens,
     engine.stats = {k: 0 if isinstance(v, int) else 0.0
                     for k, v in engine.stats.items()}
     engine.goodput.reset()  # measure this scenario's waste only
+    if getattr(engine, "costs", None) is not None and engine.costs.enabled:
+        engine.costs.reset()  # per-signature prices for this scenario
     sp = SamplingParams(temperature=0.0, max_new_tokens=gen_len)
     t0 = time.time()
     deadline = t0 + 300.0
@@ -149,6 +151,9 @@ def run_scenario(engine_cfg, prompts, gen_len, warm_lens,
     wall = time.time() - t0
     stats = dict(engine.stats)
     stats["goodput"] = engine.goodput.summary()
+    stats["costs"] = engine.costs.by_kind() \
+        if getattr(engine, "costs", None) is not None \
+        and engine.costs.enabled else None
     engine.stop()
     return reqs, wall, stats
 
@@ -653,6 +658,9 @@ print("BENCH_JSON " + json.dumps({
     # preemption recompute, rejected speculation) — the 2.8%-MFU
     # question "where did the other device-seconds go", answered per run
     "goodput": stats.get("goodput"),
+    # per-kind pass prices (us/token) from the cost observatory:
+    # report-only context for the trajectory, never a gate
+    "costs": stats.get("costs"),
     "platform": backend,
     "quantize": quant,
     "compile_cache_dir": jax.config.jax_compilation_cache_dir,
@@ -732,6 +740,12 @@ def headline_metrics(payload: dict) -> dict:
     put("goodput_busy_s", goodput.get("busy_s"))
     for cause, seconds in (goodput.get("waste_s") or {}).items():
         put(f"waste_{cause}_s", seconds)
+    # cost_* keys are per-kind µs/token prices from the pass-cost
+    # observatory: bench_compare reports them but never gates (not in
+    # THROUGHPUT_KEYS, not *_ms) — prices move with host load and
+    # shape mix, so they ride the trajectory for context only
+    for kind, us_per_token in (payload.get("costs") or {}).items():
+        put(f"cost_{kind}_us_per_token", us_per_token)
     return out
 
 

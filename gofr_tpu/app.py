@@ -307,8 +307,19 @@ class App:
             slo_tracker.events = ev_ledger
         if slo_tracker is not None and incidents is not None \
                 and getattr(slo_tracker, "on_fast_burn", True) is None:
-            slo_tracker.on_fast_burn = lambda: incidents.trigger(
-                "fast_burn", cause="SLO error-budget fast burn")
+            autoprof = getattr(engine, "autoprof", None)
+
+            def _on_fast_burn(incidents=incidents, autoprof=autoprof):
+                # arm BEFORE triggering so the bundle can point at the
+                # capture directory (serving/costmodel.py AutoProfiler;
+                # a no-op when disabled/debounced/killed)
+                capture = autoprof.arm(
+                    "fast_burn", "SLO error-budget fast burn") \
+                    if autoprof is not None else None
+                incidents.trigger(
+                    "fast_burn", cause="SLO error-budget fast burn",
+                    attrs={"autoprof_dir": (capture or {}).get("dir")})
+            slo_tracker.on_fast_burn = _on_fast_burn
         # scheduler plumbing: the engine constructed its admission
         # queue already — swap in the app-level policy and wire the
         # shed-episode WARNs to the app logger
@@ -505,6 +516,19 @@ class App:
             return out
         self.get("/debug/efficiency", efficiency_debug)
 
+        def costs_debug(ctx):
+            """Pass-cost observatory per served model: the online
+            per-dispatch-signature cost table (EWMA + variance, µs/row
+            and µs/token, sealed baselines, drift episodes) and the
+            anomaly-triggered profiler's state — the 'p95 regressed,
+            which kernel?' runbook (docs/operations.md) starts here."""
+            out = {}
+            for model_name, engine in container.models.items():
+                out[model_name] = engine.cost_state() \
+                    if hasattr(engine, "cost_state") else None
+            return out
+        self.get("/debug/costs", costs_debug)
+
         def usage_debug(ctx):
             """Per-tenant usage rollup: ``?tenant=`` filters,
             ``?window=5m`` sums over the recent-event ring instead of
@@ -679,15 +703,34 @@ class App:
         self.profiler = capture
 
         def profile_start(ctx):
+            """Body ``{"dir": ..., "max_capture_s": N}`` — N > 0 arms
+            a watchdog that stops the trace after N seconds even if
+            nobody calls stop (counted in ``status()["auto_stops"]``)."""
             try:
                 body = ctx.bind() or {}
             except Exception:
                 body = {}
-            target = body.get("dir") if isinstance(body, dict) else None
-            return capture.start(target)
+            if not isinstance(body, dict):
+                body = {}
+            target = body.get("dir")
+            try:
+                cap = float(body.get("max_capture_s") or 0.0)
+            except (TypeError, ValueError):
+                cap = 0.0
+            return capture.start(target, max_capture_s=cap or None)
 
         def profile_stop(ctx):
-            return capture.stop()
+            """Body ``{"force": true}`` recovers a leaked capture —
+            e.g. a crashed client that started a trace and never came
+            back — by stopping the profiler even when local state says
+            idle."""
+            try:
+                body = ctx.bind() or {}
+            except Exception:
+                body = {}
+            force = bool(body.get("force")) if isinstance(body, dict) \
+                else False
+            return capture.stop(force=force)
 
         def profile_status(ctx):
             return capture.status()

@@ -665,6 +665,13 @@ class ControlPlaneLeader:
                         for h, m in self._members.items()
                         if isinstance(m.summary.get("busy_s"),
                                       (int, float))}
+            # cost federation: heartbeat summaries carry each host's
+            # per-signature cost table (FlightRecorder.fleet_summary
+            # via the engine's CostModel)
+            costs = {h: dict(m.summary["costs"])
+                     for h, m in self._members.items()
+                     if isinstance(m.summary.get("costs"), Mapping)
+                     and m.summary.get("costs")}
             world = len(self._members)
         pass_skew, worst = self._skew(p95s)
         occ_skew, _ = self._skew(occs)
@@ -672,6 +679,45 @@ class ControlPlaneLeader:
         med = statistics.median(p95s.values()) if len(p95s) >= 2 else 0.0
         stragglers = sorted(h for h, v in p95s.items()
                             if med > 0 and v > threshold * med)
+        # Signature-normalized straggler mode: the raw p95 comparison
+        # above confounds "this host is slow" with "this host happens
+        # to serve heavier shapes" — a host decoding at window 2048
+        # legitimately posts fatter passes than one at 512. When >=2
+        # hosts federate cost tables, compare each host's mean pass
+        # cost for the SAME dispatch signature against the fleet
+        # median for that signature, and name the offending signature
+        # so the operator lands on the kernel, not the host.
+        straggler_mode = "p95"
+        straggler_signatures: dict[str, str] = {}
+        sig_medians: dict[str, float] = {}
+        if len(costs) >= 2:
+            straggler_mode = "signature"
+            by_sig: dict[str, dict[str, float]] = {}
+            for host, table in costs.items():
+                for sig, rec in table.items():
+                    if not isinstance(rec, Mapping):
+                        continue
+                    mean = float(rec.get("mean_s") or 0.0)
+                    if mean > 0 and int(rec.get("n") or 0) >= 2:
+                        by_sig.setdefault(sig, {})[host] = mean
+            # per-host worst offence: (signature, ratio over median)
+            worst_sig: dict[str, tuple[str, float]] = {}
+            for sig, means in by_sig.items():
+                if len(means) < 2:
+                    continue  # nobody to compare against
+                med_sig = statistics.median(means.values())
+                if med_sig <= 0:
+                    continue
+                sig_medians[sig] = round(med_sig, 6)
+                for host, mean in means.items():
+                    ratio_sig = mean / med_sig
+                    if (ratio_sig > threshold
+                            and ratio_sig > worst_sig.get(
+                                host, ("", 0.0))[1]):
+                        worst_sig[host] = (sig, ratio_sig)
+            stragglers = sorted(worst_sig)
+            straggler_signatures = {h: s for h, (s, _) in
+                                    worst_sig.items()}
         # _stragglers is also mutated by the leave/evict path under
         # _lock from HTTP handler threads; an unlocked read-modify-write
         # here (sweeper thread) can race a concurrent discard
@@ -708,26 +754,43 @@ class ControlPlaneLeader:
                                        fleet_goodput["goodput_ratio"])
         for host in sorted(new):
             if self.logger:
-                self.logger.warn(
-                    "straggler detected: pass duration skewed off the "
-                    "fleet median", host=host,
-                    p95_s=p95s.get(host), median_s=round(med, 6),
-                    skew=round(pass_skew, 3), threshold=threshold,
-                    # why is it slow? its own waste ledger answers
-                    dominant_waste=straggler_causes.get(host))
+                if straggler_mode == "signature":
+                    sig = straggler_signatures.get(host)
+                    self.logger.warn(
+                        "straggler detected: pass cost skewed off the "
+                        "fleet median for a shared dispatch signature",
+                        host=host, signature=sig,
+                        fleet_median_s=sig_medians.get(sig or ""),
+                        threshold=threshold,
+                        dominant_waste=straggler_causes.get(host))
+                else:
+                    self.logger.warn(
+                        "straggler detected: pass duration skewed off "
+                        "the fleet median", host=host,
+                        p95_s=p95s.get(host), median_s=round(med, 6),
+                        skew=round(pass_skew, 3), threshold=threshold,
+                        # why is it slow? its own waste ledger answers
+                        dominant_waste=straggler_causes.get(host))
             self.events.emit(
                 "fleet.straggler", severity="warn", epoch=self.epoch,
                 cause=straggler_causes.get(host) or "unknown",
                 straggler=host, p95_s=p95s.get(host),
+                signature=straggler_signatures.get(host),
                 skew=round(pass_skew, 3))
-        return {"pass_skew": round(pass_skew, 4),
-                "occupancy_skew": round(occ_skew, 4),
-                "straggler_ratio": round(ratio, 4),
-                "stragglers": stragglers,
-                "straggler_causes": straggler_causes,
-                "worst_host": worst,
-                "goodput": fleet_goodput,
-                "threshold": threshold}
+        out = {"pass_skew": round(pass_skew, 4),
+               "occupancy_skew": round(occ_skew, 4),
+               "straggler_ratio": round(ratio, 4),
+               "stragglers": stragglers,
+               "straggler_causes": straggler_causes,
+               "straggler_mode": straggler_mode,
+               "straggler_signatures": straggler_signatures,
+               "worst_host": worst,
+               "goodput": fleet_goodput,
+               "threshold": threshold}
+        if costs:
+            out["costs"] = {"signatures": sig_medians,
+                            "hosts": sorted(costs)}
+        return out
 
     # ------------------------------------------------------ fleet views
     def fleet_status(self) -> dict:

@@ -383,6 +383,43 @@ class EngineConfig:
     #: p99 does. O(1) host set lookups; engines that never warm up
     #: never seal, so cold compiles stay silent.
     recompile_sentinel: bool = True
+    #: pass-cost observatory (serving/costmodel.py): per-dispatch-
+    #: signature EWMA + variance of pass device time and per-row/
+    #: per-token cost, fed host-side at the existing collect
+    #: boundaries with the same durations the goodput ledger bills —
+    #: zero hot-path perturbation (transfer-guard + greedy
+    #: bit-identity hold with it ON). Surfaced at GET /debug/costs,
+    #: in /debug/efficiency, on heartbeat summaries (fleet
+    #: federation) and in workload headers (replay divergence).
+    cost_model: bool = True
+    #: EWMA weight for the per-signature cost mean/variance
+    cost_alpha: float = 0.2
+    #: serving-path passes per signature before its drift baseline
+    #: seals (warmup never feeds the model — its timings are
+    #: compile-laden)
+    cost_baseline_passes: int = 32
+    #: drift sentinel thresholds: an episode opens when a signature's
+    #: EWMA exceeds BOTH baseline * cost_drift_ratio and baseline +
+    #: cost_drift_sigma * baseline_std (ratio guards near-zero-std
+    #: baselines, sigma guards noisy ones); fires one obs.cost_drift
+    #: event + app_engine_cost_drift{kind} + one incident bundle per
+    #: episode
+    cost_drift_ratio: float = 2.0
+    cost_drift_sigma: float = 6.0
+    #: anomaly-triggered profiling (serving/costmodel.AutoProfiler):
+    #: cost drift, SLO fast-burn or a goodput-floor breach arms a
+    #: single-flight ProfilerCapture that auto-stops after
+    #: autoprof_passes collected passes or autoprof_max_capture_s;
+    #: arms are debounced and GOFR_AUTOPROF=0 is the kill-switch.
+    #: The artifact path + cost table attach to the incident bundle.
+    autoprof: bool = True
+    autoprof_passes: int = 64
+    autoprof_max_capture_s: float = 30.0
+    autoprof_debounce_s: float = 300.0
+    #: goodput-ratio floor that arms the autoprofiler (checked at the
+    #: throttled gauge cadence once busy_s > 1); 0 disables the floor
+    autoprof_goodput_floor: float = 0.0
+    autoprof_dir: str = "/tmp/gofr_tpu_profiles"
     #: admission/scheduling/shedding policy (serving/scheduler.py):
     #: weighted fair-share dequeue over per-tenant sub-queues,
     #: interactive/background lanes with starvation preemption,
@@ -455,6 +492,31 @@ class Engine:
         self.watermarks = WatermarkTracker(config.goodput)
         #: post-warmup recompile detection by dispatch shape signature
         self.sentinel = RecompileSentinel(config.recompile_sentinel)
+        #: pass-cost observatory: per-signature EWMA/variance cost
+        #: model + drift sentinel, fed at the collect boundaries with
+        #: the same durations the goodput ledger bills
+        from .costmodel import AutoProfiler, CostModel
+        self.costs = CostModel(config.cost_model,
+                               alpha=config.cost_alpha,
+                               baseline_passes=config.cost_baseline_passes,
+                               drift_ratio=config.cost_drift_ratio,
+                               drift_sigma=config.cost_drift_sigma)
+        if self.costs.enabled:
+            # heartbeat summaries carry the cost table: the leader's
+            # straggler math compares hosts on the SAME signature
+            self.recorder.cost_source = self.costs.table
+        #: anomaly-triggered profiling: drift / fast-burn / goodput
+        #: floor arm a bounded single-flight ProfilerCapture
+        _capture = None
+        if config.autoprof:
+            from .observability import ProfilerCapture
+            _capture = ProfilerCapture(base_dir=config.autoprof_dir,
+                                       logger=logger)
+        self.autoprof = AutoProfiler(
+            _capture, enabled=config.autoprof,
+            passes=config.autoprof_passes,
+            max_capture_s=config.autoprof_max_capture_s,
+            debounce_s=config.autoprof_debounce_s, logger=logger)
         if self.goodput.enabled:
             # heartbeats and workload headers carry the waste digest
             self.recorder.goodput_source = self.goodput.summary
@@ -472,6 +534,11 @@ class Engine:
                                          redact=config.capture_redact)
         if self.goodput.enabled:
             self.workload.goodput_source = self.goodput.summary
+        if self.costs.enabled:
+            # captured workloads carry the recording side's cost table
+            # (additive header field) so replay can report per-
+            # signature divergence next to efficiency_divergence
+            self.workload.cost_source = self.costs.table
         #: per-tenant usage metering, fed at retire (_finalize_obs);
         #: always present (host dicts only) — attach_metrics points it
         #: at the metrics manager so app_tenant_* series populate
@@ -797,6 +864,11 @@ class Engine:
             "watermarks": self.watermarks.state,
             "recorder": self.recorder.snapshot,
             "config": self.config_digest,
+            # every bundle ships the per-signature cost table + the
+            # autoprofiler state ("which kernel class got slower, and
+            # where is the trace") — the cost_drift reason's bundle
+            # additionally carries the capture dir in its attrs
+            "costs": self.cost_state,
         })
         # crash-recovery supervisor state (see _recover / RestartPolicy)
         self._restarts = 0
@@ -933,7 +1005,7 @@ class Engine:
                       "spec_accepted": 0, "spec_drafted": 0,
                       "spec_rows": 0, "preemptions": 0,
                       "requeues": 0, "prefix_evictions": 0,
-                      "stalls": 0, "recompiles": 0}
+                      "stalls": 0, "recompiles": 0, "cost_drifts": 0}
         #: waste-counter watermark already published to the metrics
         #: manager (the throttled gauge pass emits deltas)
         self._waste_published: dict[str, float] = {}
@@ -1240,6 +1312,10 @@ class Engine:
             ("app_engine_recompiles",
              "unexpected post-warmup XLA recompiles detected by the "
              "dispatch-shape sentinel"),
+            ("app_engine_cost_drift",
+             "pass-cost drift episodes by dispatch kind: a signature's "
+             "cost EWMA departed its sealed baseline past the "
+             "configured ratio/sigma thresholds (serving/costmodel.py)"),
             ("app_engine_restarts",
              "engine loop restarts by the in-thread crash-recovery "
              "supervisor (EngineConfig.restart_policy)"),
@@ -1929,10 +2005,11 @@ class Engine:
                         if self._native_chunk:
                             self._note_view_avoided(G)
                         c_dur = time.perf_counter() - c0
+                        chunk_sig = self._sig_str("chunk", width, G, cw)
                         if self.recorder.enabled:
                             self.recorder.record_pass(
                                 "prefill_chunk", rows=len(ready),
-                                width=width,
+                                width=width, sig=chunk_sig,
                                 dur=round(c_dur, 6),
                                 view_avoided=self._native_chunk,
                                 queue_depth=self.waiting.qsize())
@@ -1945,6 +2022,12 @@ class Engine:
                         self.goodput.add_prefill(
                             "prefill_chunk", c_dur, G,
                             len(ready) - recomp, recomp)
+                        # cost observatory: same duration the ledger
+                        # just billed; tokens = the compiled shape's
+                        # G x width positions (what the graph costs)
+                        self._note_pass_cost(
+                            "chunk", chunk_sig, c_dur,
+                            rows=len(ready), tokens=G * width)
                         w1 = time.time()  # gofrlint: allow(hot-path-purity) -- span timestamps use wall clock; once per chunk dispatch
                         for r in ready:
                             r.device_s += c_dur / len(ready)
@@ -2355,6 +2438,67 @@ class Engine:
             "obs.recompile", severity="warn",
             signature="/".join(str(p) for p in sig))
 
+    def _sig_str(self, *parts: Any) -> str:
+        """The sentinel's rendered signature string — the join key the
+        cost table, flight-recorder pass records, /debug/costs and the
+        fleet federation all share."""
+        return "/".join(str(p) for p in self._sig(*parts))
+
+    @hot_path_boundary(
+        "cost-model fold at the collect boundary: host float EWMA "
+        "updates over the pass duration the collect already measured; "
+        "the event/metric/WARN/incident and the profiler arm fire only "
+        "on a rare drift-episode entry")
+    def _note_pass_cost(self, kind: str, sig_str: str, dur: float, *,
+                        rows: int = 0, tokens: int = 0) -> None:
+        """Feed one collected pass to the cost observatory. Called at
+        every collect site with the SAME duration the goodput ledger
+        bills, so /debug/costs conserves against busy seconds. A drift
+        episode entry (CostModel.observe returns a record once per
+        episode) emits obs.cost_drift, WARNs once, bumps
+        app_engine_cost_drift{kind}, arms the autoprofiler and opens a
+        cost_drift incident bundle carrying the capture dir."""
+        self.autoprof.note_pass()
+        if not self.costs.enabled:
+            return
+        skew = 0.0
+        if self.faults is not NO_FAULTS \
+                and self.faults.trip("cost_skew", sig_str):
+            # deterministic drift induction: inflate the OBSERVED
+            # duration only — no sleep, no token perturbation, greedy
+            # outputs stay bit-identical (serving/faults.py)
+            skew = self.faults.payload("cost_skew")
+        drift = self.costs.observe(kind, sig_str, dur, rows=rows,
+                                   tokens=tokens, skew_s=skew)
+        if drift is None:
+            return
+        self.stats["cost_drifts"] += 1
+        if self.metrics is not None:
+            self.metrics.increment_counter("app_engine_cost_drift",
+                                           kind=kind)
+        if self.logger is not None:
+            self.logger.warn(
+                "pass cost drifted off its sealed baseline",
+                signature=sig_str, ewma_s=drift["ewma_s"],
+                baseline_s=drift["baseline_s"], ratio=drift["ratio"])
+        self.events.emit("obs.cost_drift", severity="warn",
+                         signature=sig_str, pass_kind=kind,
+                         ratio=drift["ratio"], ewma_s=drift["ewma_s"],
+                         baseline_s=drift["baseline_s"])
+        capture = self.autoprof.arm(
+            "cost_drift", f"pass cost drift: {sig_str}")
+        self.incidents.trigger(
+            "cost_drift", cause=f"pass cost drift: {sig_str}",
+            attrs={**drift,
+                   "autoprof_dir": (capture or {}).get("dir")})
+
+    def cost_state(self) -> dict:
+        """The per-model ``GET /debug/costs`` payload: the full cost
+        table plus the autoprofiler's state — also an incident-bundle
+        source, so every bundle names which kernel class got slower."""
+        return {"costs": self.costs.state(),
+                "autoprof": self.autoprof.state()}
+
     def _note_device_idle(self) -> None:
         """Goodput bubble tracking: a synchronous collect finished and
         no dispatched pass remains in flight — from the host's view the
@@ -2685,10 +2829,14 @@ class Engine:
             now = time.time()  # gofrlint: allow(hot-path-purity) -- wall-clock span assembly at the prefill collect boundary, once per batch
             pass_dur = time.perf_counter() - rec["t0"]
             pass_share = pass_dur / max(1, len(rec["placed"]))
+            # the dispatch's (bucket, group) signature: group size is
+            # the padded batch axis the graph compiled for
+            prefill_sig = self._sig_str("prefill", rec.get("bucket"),
+                                        int(toks_np.shape[0]))
             if self.recorder.enabled:
                 self.recorder.record_pass(
                     "prefill", rows=len(rec["placed"]),
-                    bucket=rec.get("bucket"),
+                    bucket=rec.get("bucket"), sig=prefill_sig,
                     dur=round(pass_dur, 6),
                     occupancy=sum(r is not None for r in self.active),
                     queue_depth=self.waiting.qsize())
@@ -2733,6 +2881,10 @@ class Engine:
             self.goodput.add_prefill("prefill", pass_dur,
                                      int(toks_np.shape[0]), fresh_rows,
                                      recompute_rows)
+            self._note_pass_cost(
+                "prefill", prefill_sig, pass_dur,
+                rows=int(toks_np.shape[0]),
+                tokens=int(toks_np.shape[0]) * (rec.get("bucket") or 0))
             self._update_kv_watermarks()
         self._note_device_idle()
 
@@ -3021,6 +3173,7 @@ class Engine:
             "valid": valid,
             "t0": start,
             "disp": disp,
+            "win": win,
             "h2d": self.stats["h2d_transfers"] - h2d0,
         })
         self.stats["dispatch_s"] += disp
@@ -3093,6 +3246,9 @@ class Engine:
         # the goodput ledger bills — an accepted draft token is worth
         # exactly what a plain-decode token costs
         self._spec_ctrl.note_decode(busy, emitted)
+        decode_sig = self._sig_str("decode", rec.get("win", 0))
+        self._note_pass_cost("decode", decode_sig, busy,
+                             rows=credited, tokens=emitted)
         if self.recorder.enabled:
             # the pass record: everything here is a host int/float the
             # collect already computed — no device reads beyond the
@@ -3101,6 +3257,7 @@ class Engine:
                 "decode", dur=round(busy, 6),
                 dispatch_s=round(rec.get("disp", 0.0), 6),
                 collect_s=round(collect, 6), occupancy=occupancy,
+                sig=decode_sig,
                 queue_depth=self.waiting.qsize(), tokens=emitted,
                 h2d=rec.get("h2d", 0),
                 preemptions=self.stats["preemptions"])
@@ -3494,13 +3651,17 @@ class Engine:
         # fit the controller's verify row cost from the same span the
         # ledger bills, so policy and waste accounting can't diverge
         self._spec_ctrl.note_verify(spec_dur, pass_rows, width)
+        spec_sig = self._sig_str("spec_verify", width)
+        self._note_pass_cost("spec_verify", spec_sig, spec_dur,
+                             rows=pass_rows,
+                             tokens=pass_accepted + pass_rows)
         self._update_kv_watermarks()
         if self.recorder.enabled:
             self.recorder.record_pass(
                 "spec_verify", rows=pass_rows, drafted=pass_drafted,
                 accepted=pass_accepted,
                 dur=round(time.perf_counter() - start, 6),
-                occupancy=pass_rows,
+                occupancy=pass_rows, sig=spec_sig,
                 queue_depth=self.waiting.qsize())
         self._note_device_idle()
 
@@ -3549,6 +3710,7 @@ class Engine:
                 "watermarks": self.watermarks.state(),
                 "recompiles": self.sentinel.state(),
                 "spec": self._spec_ctrl.state(),
+                "costs": self.costs.state(),
                 "kv_bytes": self._kv_bytes_total,
                 "kv_bytes_per_token": round(
                     self._kv_bytes_total / max(1, cap_tokens), 3)}
@@ -3577,8 +3739,16 @@ class Engine:
         m.set_gauge("app_engine_tokens_per_second", round(tps, 2))
         gp = self.goodput
         if gp.enabled and gp.busy_s > 0:
-            m.set_gauge("app_engine_goodput_ratio",
-                        round(gp.useful_s / gp.busy_s, 6))
+            ratio = gp.useful_s / gp.busy_s
+            m.set_gauge("app_engine_goodput_ratio", round(ratio, 6))
+            # goodput-floor breach arms a bounded auto-capture; off by
+            # default (floor 0.0), and the 1s busy guard keeps a cold
+            # engine's first noisy ratio from tripping it
+            floor = self.config.autoprof_goodput_floor
+            if floor > 0.0 and gp.busy_s > 1.0 and ratio < floor:
+                self.autoprof.arm(
+                    "goodput_floor",
+                    f"goodput ratio {ratio:.3f} below floor {floor:.3f}")
             for cause, total in gp.waste_s.items():
                 delta = total - self._waste_published.get(cause, 0.0)
                 if delta > 0:  # counters take deltas, the meter totals

@@ -81,8 +81,9 @@ KINDS = frozenset({
     # router.py — front-door actions
     "router.retry", "router.failover", "router.affinity_drop",
     "router.scale",
-    # observability.py — efficiency sentinels
+    # observability.py / costmodel.py — efficiency sentinels
     "obs.recompile", "obs.watermark", "obs.fast_burn",
+    "obs.cost_drift",
     # events.py itself — an incident bundle was spooled
     "incident.open",
 })
@@ -449,8 +450,11 @@ def _git_digest(start: str | None = None) -> dict:
 class IncidentDetector:
     """Snapshots a diagnostic bundle when the fleet does something an
     operator will be asked about: an SLO **fast_burn** trip, a
-    committed leader **failover**, or a crash-restart budget overrun
-    (**restart_budget**).
+    committed leader **failover**, a crash-restart budget overrun
+    (**restart_budget**), or a dispatch signature's pass cost departing
+    its sealed baseline (**cost_drift** — serving/costmodel.py; the
+    bundle's ``costs`` source carries the per-signature table and the
+    auto-captured profiler artifact path rides the trigger attrs).
 
     The bundle is assembled from pluggable zero-arg ``sources`` (slo /
     scheduler / watermarks / goodput / recorder / config blocks — a
@@ -461,7 +465,7 @@ class IncidentDetector:
     3am page links to a bundle that, by the time a human opens it,
     covers both sides of the incident."""
 
-    REASONS = ("fast_burn", "failover", "restart_budget")
+    REASONS = ("fast_burn", "failover", "restart_budget", "cost_drift")
 
     def __init__(self, config: EventLedgerConfig | None = None, *,
                  ledger: EventLedger | None = None, host: str = "",

@@ -43,6 +43,11 @@ view):
 ``stale_epoch_replay``    the leader answers a heartbeat with ``epoch - 1``
                           — a replayed/stale ack; exercises worker-side
                           epoch fencing (the ack must be rejected)
+``cost_skew``             report-only: the engine inflates the duration it
+                          feeds the pass-cost model by ``seconds`` for the
+                          dispatch signature in ``request`` — deterministic
+                          drift induction with zero sleep and zero token
+                          perturbation (greedy outputs stay bit-identical)
 ========================  =====================================================
 
 The disabled plan is the module-level :data:`NO_FAULTS` singleton; call
@@ -78,6 +83,7 @@ SITES = frozenset({
     "pass_raise", "pass_stall", "pass_latency", "page_exhaustion",
     "nan_logits", "heartbeat_drop", "join_refused",
     "leader_down", "leader_partition", "ack_drop", "stale_epoch_replay",
+    "cost_skew",
 })
 
 # sites whose firing is a raise vs. a sleep; the rest report True and
@@ -140,6 +146,14 @@ class FaultPlan:
             spec.seen = 0
         self.fired.clear()
 
+    def payload(self, site: str) -> float:
+        """Largest ``seconds`` payload armed for ``site`` — the side
+        channel the report-only sites carry a magnitude through (e.g.
+        ``cost_skew``'s synthetic duration inflation). Static per plan,
+        so the injected value is as deterministic as the trigger."""
+        return max((s.seconds for s in self._by_site.get(site, ())),
+                   default=0.0)
+
     def describe(self) -> list[dict]:
         return [{"site": s.site, "at": s.at, "times": s.times,
                  "seconds": s.seconds, "request": s.request,
@@ -155,7 +169,7 @@ class FaultPlan:
         covers it. Raises :class:`InjectedFault` for the raise sites,
         sleeps for the stall/latency sites, returns True for the
         report-only sites (page_exhaustion / heartbeat_drop /
-        join_refused)."""
+        join_refused / cost_skew)."""
         specs = self._by_site.get(site)
         if not specs:
             return False

@@ -55,6 +55,11 @@ class FlightRecorder:
         #: rides fleet_summary so the leader's router can score hosts
         #: by longest resident prefix without any new protocol
         self.prefix_digest_source: Any = None
+        #: optional () -> per-signature cost table (CostModel.table);
+        #: rides fleet_summary so the leader can compare hosts on the
+        #: SAME compiled graph (signature-normalized straggler math)
+        #: instead of the workload-mix-confounded p95
+        self.cost_source: Any = None
 
     # ------------------------------------------------------------ writers
     def record_pass(self, kind: str, **fields: Any) -> None:
@@ -131,6 +136,13 @@ class FlightRecorder:
                 digest = None
             if digest:
                 out["prefix_digest"] = digest
+        if self.cost_source is not None:
+            try:
+                costs = self.cost_source()
+            except Exception:
+                costs = None
+            if costs:
+                out["costs"] = costs
         return out
 
     def dump(self, logger: Any, reason: str = "") -> None:
@@ -509,6 +521,10 @@ class WorkloadRecorder:
         #: the header then carries the capture-side efficiency digest
         #: so a replay can compare waste breakdowns, not just tokens
         self.goodput_source: Any = None
+        #: optional () -> CostModel.table, wired by the engine: the
+        #: header then carries the capture-side per-signature cost
+        #: table so a replay can report per-kernel-class divergence
+        self.cost_source: Any = None
 
     # ------------------------------------------------------------ control
     def start(self, redact: bool | None = None) -> dict:
@@ -599,6 +615,14 @@ class WorkloadRecorder:
                 g = self.goodput_source()
                 if g and g.get("busy_s"):
                     out["goodput"] = g
+            except Exception:
+                pass
+        if self.cost_source is not None:
+            # additive field, same contract as the goodput block
+            try:
+                costs = self.cost_source()
+                if costs:
+                    out["costs"] = costs
             except Exception:
                 pass
         return out
@@ -1151,17 +1175,33 @@ class ProfilerCapture:
     ``jax.profiler.start_trace/stop_trace`` with single-flight
     semantics — the state machine behind ``POST /debug/profile/start``
     and ``/debug/profile/stop``. A second start while a capture runs is
-    refused (JAX would raise); stop without a start reports cleanly."""
+    refused (JAX would raise); stop without a start reports cleanly.
+
+    Hardening: a capture started with ``max_capture_s`` (per-start or
+    the constructor default) is auto-stopped by a daemon watchdog timer
+    — a forgotten ``stop`` can no longer let xprof buffer events
+    forever. ``stop(force=True)`` recovers a crashed/leaked capture:
+    it calls ``jax.profiler.stop_trace`` even when this state machine
+    thinks nothing is running (a previous failed stop cleared the local
+    state while JAX kept tracing) and swallows the stop error, so the
+    next ``start`` works again."""
 
     def __init__(self, base_dir: str = "/tmp/gofr_tpu_profiles",
-                 logger: Any = None) -> None:
+                 logger: Any = None,
+                 max_capture_s: float = 0.0) -> None:
         self.base_dir = base_dir
         self.logger = logger
+        #: default auto-stop budget for every capture; 0 = unbounded
+        #: (per-start ``max_capture_s`` overrides)
+        self.max_capture_s = max(0.0, float(max_capture_s))
         self._lock = threading.Lock()
         self._active_dir: str | None = None
         self._started_at: float | None = None
+        self._timer: threading.Timer | None = None
+        self.auto_stops = 0
 
-    def start(self, trace_dir: str | None = None) -> dict:
+    def start(self, trace_dir: str | None = None, *,
+              max_capture_s: float | None = None) -> dict:
         with self._lock:
             if self._active_dir is not None:
                 return {"ok": False, "error": "capture already running",
@@ -1176,20 +1216,61 @@ class ProfilerCapture:
                 return {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
             self._active_dir = path
             self._started_at = time.time()
+            cap = self.max_capture_s if max_capture_s is None \
+                else max(0.0, float(max_capture_s))
+            if cap > 0:
+                self._timer = threading.Timer(cap, self._expire, (path,))
+                self._timer.daemon = True
+                self._timer.start()
             if self.logger:
                 self.logger.info(f"profiler capture started: {path}")
             return {"ok": True, "dir": path}
 
-    def stop(self) -> dict:
+    def _expire(self, path: str) -> None:
+        """Watchdog body: stop the capture iff it is still the one the
+        timer was armed for (a manual stop + fresh start must not be
+        killed by the previous capture's timer)."""
         with self._lock:
+            if self._active_dir != path:
+                return
+            self.auto_stops += 1
+        result = self.stop()
+        if self.logger and result.get("ok"):
+            self.logger.warn(
+                f"profiler capture auto-stopped at max_capture_s: {path}")
+
+    def stop(self, force: bool = False) -> dict:
+        with self._lock:
+            timer, self._timer = self._timer, None
+            if timer is not None:
+                timer.cancel()
             if self._active_dir is None:
-                return {"ok": False, "error": "no capture running"}
+                if not force:
+                    return {"ok": False, "error": "no capture running"}
+                # leaked capture: a crashed stop cleared our state while
+                # JAX kept tracing — stop the underlying trace so the
+                # state machine and the profiler agree again
+                try:
+                    import jax
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+                if self.logger:
+                    self.logger.warn(
+                        "profiler force-stop: recovered a leaked capture")
+                return {"ok": True, "recovered": True, "dir": None}
             path, self._active_dir = self._active_dir, None
             started, self._started_at = self._started_at, None
             try:
                 import jax
                 jax.profiler.stop_trace()
             except Exception as exc:
+                if force:
+                    if self.logger:
+                        self.logger.warn(
+                            f"profiler force-stop swallowed: {exc!r}")
+                    return {"ok": True, "recovered": True, "dir": path,
+                            "error": f"{type(exc).__name__}: {exc}"}
                 return {"ok": False, "dir": path,
                         "error": f"{type(exc).__name__}: {exc}"}
             if self.logger:
@@ -1200,4 +1281,5 @@ class ProfilerCapture:
 
     def status(self) -> dict:
         return {"running": self._active_dir is not None,
-                "dir": self._active_dir}
+                "dir": self._active_dir,
+                "auto_stops": self.auto_stops}

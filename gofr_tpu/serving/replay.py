@@ -145,6 +145,34 @@ def efficiency_divergence(recorded: dict | None,
     return out
 
 
+def cost_divergence(recorded: dict | None, replayed: dict | None, *,
+                    ratio: float = 2.0,
+                    floor_s: float = 0.0005) -> list[dict]:
+    """Dispatch signatures whose replayed mean pass cost materially
+    exceeds the capture's (more than ``ratio`` times, past an absolute
+    ``floor_s`` so µs-scale jitter on tiny passes never flags). The
+    per-signature twin of :func:`efficiency_divergence`: a replay that
+    matches every token but doubles the cost of ``decode/2048`` is a
+    kernel regression with a name, not a diffuse slowdown. Advisory
+    only — purely report, never a gate."""
+    if not isinstance(recorded, dict) or not isinstance(replayed, dict):
+        return []
+    out = []
+    for sig in sorted(set(recorded) & set(replayed)):
+        rec, rep = recorded.get(sig), replayed.get(sig)
+        if not isinstance(rec, dict) or not isinstance(rep, dict):
+            continue
+        a = float(rec.get("mean_s") or 0.0)
+        b = float(rep.get("mean_s") or 0.0)
+        if a > 0 and b > ratio * a + floor_s:
+            out.append({"signature": sig,
+                        "kind": rep.get("kind") or rec.get("kind"),
+                        "recorded_mean_s": round(a, 6),
+                        "replayed_mean_s": round(b, 6),
+                        "ratio": round(b / a, 3)})
+    return out
+
+
 # -------------------------------------------------------------- replay
 def load_events(path: str) -> dict:
     """Load a ``GET /debug/events`` capture (gofr-events JSONL) for
@@ -190,6 +218,11 @@ def replay_workload(engine: Any, workload: dict, *, speed: float = 1.0,
         # a clean meter for this replay: the report compares the
         # replay's OWN waste breakdown against the capture's
         goodput.reset()
+    costs = getattr(engine, "costs", None)
+    if costs is not None and getattr(costs, "enabled", False):
+        # same deal for the cost observatory: the per-signature table
+        # in the report is this replay's, not the engine's lifetime
+        costs.reset()
     # seq watermark: only events emitted DURING this replay count
     # toward the event-timeline diff
     ledger = getattr(engine, "events", None)
@@ -282,6 +315,9 @@ def replay_workload(engine: Any, workload: dict, *, speed: float = 1.0,
     recorded_goodput = header.get("goodput")
     replayed_goodput = goodput.summary() if goodput is not None \
         and getattr(goodput, "enabled", False) else None
+    recorded_costs = header.get("costs")
+    replayed_costs = costs.table() if costs is not None \
+        and getattr(costs, "enabled", False) else None
     event_divergence = None
     if events is not None:
         from .events import event_timeline_diff
@@ -311,6 +347,12 @@ def replay_workload(engine: Any, workload: dict, *, speed: float = 1.0,
         "replayed_goodput": replayed_goodput,
         "efficiency_divergence": efficiency_divergence(
             recorded_goodput, replayed_goodput),
+        # per-signature twin: same tokens, same waste shares, but one
+        # kernel's pass cost doubled — the advisory names the signature
+        "recorded_costs": recorded_costs,
+        "replayed_costs": replayed_costs,
+        "cost_divergence": cost_divergence(recorded_costs,
+                                           replayed_costs),
         # behavioral twin: the flight recorder's event timeline
         # (restarts, sheds, preemptions) compared kind-for-kind
         "event_divergence": event_divergence,
@@ -325,4 +367,4 @@ def replay_file(engine: Any, path: str, **kw) -> dict:
 
 __all__ = ["parse_workload", "load_workload", "load_events",
            "replay_workload", "replay_file", "efficiency_divergence",
-           "MAX_DIVERGENCES_REPORTED"]
+           "cost_divergence", "MAX_DIVERGENCES_REPORTED"]

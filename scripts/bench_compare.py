@@ -222,6 +222,16 @@ def self_test() -> int:
                 spec_adaptive_regression=0.5,
                 spec_waste_static_s=0.2,
                 spec_waste_adaptive_s=0.1)], 0),
+        # cost_* per-kind µs/token prices are report-only: pass prices
+        # move with host load and shape mix, so even a doubled decode
+        # price must never gate (not in THROUGHPUT_KEYS, not *_ms —
+        # the _token suffix keeps them out of the latency rule)
+        ("cost us-per-token doubles but never gates",
+         [dict(base, metrics=dict(base["metrics"],
+                                  cost_decode_us_per_token=120.0,
+                                  cost_prefill_us_per_token=40.0)),
+          entry(2.0, cost_decode_us_per_token=260.0,
+                cost_prefill_us_per_token=95.0)], 0),
     ]
     failed = 0
     for name, entries, want in checks:

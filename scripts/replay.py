@@ -90,6 +90,14 @@ def main() -> int:
         print(f"# EFFICIENCY DIVERGED: {div['cause']} waste share "
               f"{div['recorded_share']:.1%} -> "
               f"{div['replayed_share']:.1%}", file=sys.stderr)
+    for div in report.get("cost_divergence") or []:
+        # advisory too: replay hardware legitimately differs from the
+        # capture host, but a single signature's pass cost doubling
+        # while the rest hold is a kernel regression with a name
+        print(f"# COST DIVERGED: {div['signature']} mean pass "
+              f"{div['recorded_mean_s'] * 1e3:.3f}ms -> "
+              f"{div['replayed_mean_s'] * 1e3:.3f}ms "
+              f"(x{div['ratio']})", file=sys.stderr)
     ev_div = report.get("event_divergence")
     if ev_div and ev_div.get("diverged"):
         # advisory like the efficiency diff: replay timing legitimately

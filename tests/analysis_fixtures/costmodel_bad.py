@@ -1,0 +1,21 @@
+"""hot-path-purity: pass-cost accounting inlined in the hot loop —
+the anti-pattern serving/costmodel.py exists to prevent. Lines matter
+— test_analysis.py pins them."""
+import time
+
+from gofr_tpu.analysis import hot_path
+
+
+class Engine:
+    @hot_path
+    def step(self, batch):
+        # ad-hoc cost accounting: wall-clock read, counter and log
+        # write from the dispatch path
+        self.costs[batch.sig] = time.time() - self.t0            # L14
+        self.metrics.increment_counter("app_cost_drift")         # L15
+        self.logger.warn("pass cost drifted", sig=batch.sig)     # L16
+        return self._price(batch)
+
+    def _price(self, batch):
+        # undecorated helper on the closure: its clock read flags too
+        return batch, time.time() - self.t0                      # L21

@@ -1,0 +1,37 @@
+"""hot-path-purity: the clean twin — a per-signature CostModel whose
+``observe`` is a @hot_path_boundary (the serving/costmodel.py
+pattern): the EWMA fold, drift compare and counter bump are host-side
+bookkeeping over durations the collect already measured, so the purity
+walk stops at the model. None of this may be flagged."""
+
+from gofr_tpu.analysis import hot_path, hot_path_boundary
+
+
+class CostModel:
+    @hot_path_boundary("cost-model fold at the collect boundary: EWMA "
+                       "and drift compares over host floats the "
+                       "collect already measured — the purity walk "
+                       "stops here by design")
+    def observe(self, kind, sig, dur_s):
+        # inside the boundary anything goes — this models
+        # serving/costmodel.py CostModel.observe
+        rec = self.table.setdefault(sig, {"ewma": dur_s, "n": 0})
+        rec["ewma"] += self.alpha * (dur_s - rec["ewma"])
+        rec["n"] += 1
+        self.metrics.increment_counter("app_cost_observed", kind=kind)
+        return rec
+
+
+DISABLED = CostModel()
+
+
+class Engine:
+    @hot_path
+    def step(self, batch, dur_s):
+        # the fold: one boundary call, nothing inline
+        if self.costs is not DISABLED:
+            self.costs.observe("decode", batch.sig, dur_s)
+        return self._advance(batch)
+
+    def _advance(self, batch):
+        return batch

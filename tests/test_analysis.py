@@ -158,6 +158,48 @@ class TestEventLedgerContract:
         assert not offenders, offenders
 
 
+# -------------------------------------------------- cost-model contract
+class TestCostModelContract:
+    """The serving/costmodel.py contract, lint-enforced: pass-cost
+    accounting is legal ONLY through @hot_path_boundary folds
+    (``CostModel.observe`` / ``Engine._note_pass_cost``) — inline EWMA
+    updates, wall-clock reads or drift counters in a hot root (or a
+    closure-reached helper) must flag."""
+
+    def test_inline_cost_accounting_flags(self):
+        got = violations(lint("costmodel_bad.py"), "hot-path-purity")
+        lines = {f.line for f in got}
+        assert {14, 15, 16} <= lines          # inline price + telemetry
+        assert 21 in lines                    # closure-reached helper
+
+    def test_boundary_fold_is_clean(self):
+        assert violations(lint("costmodel_good.py"),
+                          "hot-path-purity") == []
+
+    def test_live_folds_declare_boundaries(self):
+        # the real modules, not fixtures: both the model's fold and
+        # the engine's per-pass feed must keep their boundaries (with
+        # reasons) or every collect site would drag the EWMA math,
+        # drift counters and WARNs into the hot closure
+        from gofr_tpu.serving.costmodel import CostModel
+        from gofr_tpu.serving.engine import Engine
+        for entry in (CostModel.observe, Engine._note_pass_cost):
+            reason = getattr(entry, "__gofr_hot_path_boundary__", "")
+            assert isinstance(reason, str) and reason.strip(), entry
+
+    def test_live_repo_hot_closure_excludes_costmodel(self):
+        # with the cost model ON by default, the engine's hot closure
+        # must not grow into costmodel.py: observation is only
+        # reachable through already-declared boundary sites
+        from gofr_tpu.analysis.callgraph import CallGraph
+        from gofr_tpu.analysis.core import load_project
+        project = load_project([REPO / "gofr_tpu" / "serving"], root=REPO)
+        closure = CallGraph(project).hot_closure()
+        offenders = [str(k) for k in closure
+                     if k.module.endswith("costmodel.py")]
+        assert not offenders, offenders
+
+
 # ------------------------------------------------ speculation contract
 class TestSpeculationContract:
     """The drafting/controller contract, lint-enforced: n-gram index

@@ -1,0 +1,348 @@
+"""Unit tests for the pass-cost observatory (serving/costmodel.py):
+the per-signature CostModel's EWMA/baseline/drift state machine, the
+AutoProfiler's single-flight/debounce/auto-stop guards, the hardened
+ProfilerCapture (watchdog + force-stop recovery), the cost_skew fault
+site, and the replay cost-divergence advisory. Everything here is
+clock-free or injected-clock — determinism is the contract."""
+
+import threading
+import time
+
+import pytest
+
+from gofr_tpu.serving.costmodel import AutoProfiler, CostModel
+from gofr_tpu.serving.faults import FaultPlan
+from gofr_tpu.serving.replay import cost_divergence
+
+
+# --------------------------------------------------------------- fakes
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class FakeCapture:
+    """Stands in for ProfilerCapture: records start/stop calls."""
+
+    def __init__(self):
+        self.starts: list = []
+        self.stops = 0
+        self.refuse = False
+        self.stop_result = {"ok": True, "duration_s": 0.5}
+
+    def start(self, trace_dir=None, *, max_capture_s=None):
+        if self.refuse:
+            return {"ok": False, "error": "refused"}
+        self.starts.append(max_capture_s)
+        return {"ok": True, "dir": f"/fake/capture-{len(self.starts)}"}
+
+    def stop(self, force=False):
+        self.stops += 1
+        return dict(self.stop_result)
+
+
+def feed(model, n, dur, sig="decode/0", kind="decode", **kw):
+    out = []
+    for _ in range(n):
+        out.append(model.observe(kind, sig, dur, **kw))
+    return out
+
+
+# ----------------------------------------------------------- CostModel
+class TestCostModel:
+    def test_steady_costs_seal_a_baseline_and_never_drift(self):
+        m = CostModel(baseline_passes=8)
+        drifts = feed(m, 50, 0.01, tokens=4)
+        assert all(d is None for d in drifts)
+        sig = m.state()["signatures"]["decode/0"]
+        assert sig["baseline_s"] == pytest.approx(0.01)
+        assert sig["n"] == 50 and not sig["drifting"]
+        assert m.drift_episodes == 0
+
+    def test_identical_feeds_are_deterministic(self):
+        a, b = CostModel(baseline_passes=4), CostModel(baseline_passes=4)
+        seq = [0.01, 0.012, 0.009, 0.011, 0.05, 0.08, 0.02]
+        ra = [a.observe("decode", "decode/0", d, tokens=2) for d in seq]
+        rb = [b.observe("decode", "decode/0", d, tokens=2) for d in seq]
+        assert ra == rb
+        assert a.state() == b.state()
+
+    def test_drift_fires_exactly_once_per_episode(self):
+        m = CostModel(baseline_passes=4, drift_ratio=2.0, drift_sigma=6.0)
+        assert all(d is None for d in feed(m, 4, 0.01))
+        drifts = [d for d in feed(m, 12, 0.1) if d is not None]
+        assert len(drifts) == 1
+        d = drifts[0]
+        assert d["kind"] == "decode" and d["signature"] == "decode/0"
+        assert d["ratio"] > 2.0
+        assert d["ewma_s"] > d["baseline_s"]
+        assert m.drift_episodes == 1
+        assert m.state()["signatures"]["decode/0"]["drifting"]
+
+    def test_hysteresis_ends_the_episode_and_allows_a_second(self):
+        m = CostModel(baseline_passes=4, drift_ratio=2.0, drift_sigma=0.0)
+        feed(m, 4, 0.01)
+        assert any(feed(m, 10, 0.1))          # episode 1 opens
+        # recovery: EWMA decays back under the midpoint (1.5x base)
+        feed(m, 40, 0.01)
+        assert not m.state()["signatures"]["decode/0"]["drifting"]
+        # a fresh excursion opens a SECOND episode, exactly once
+        drifts = [d for d in feed(m, 12, 0.1) if d is not None]
+        assert len(drifts) == 1
+        assert m.drift_episodes == 2
+
+    def test_conservation_separates_synthetic_inflation(self):
+        m = CostModel(baseline_passes=4)
+        real = [0.01, 0.02, 0.015, 0.01]
+        for dur in real:
+            m.observe("decode", "decode/0", dur, skew_s=0.5)
+        # total includes the injected skew; synthetic names it, so
+        # total - synthetic conserves against the real busy seconds
+        assert m.synthetic_s == pytest.approx(2.0)
+        assert m.total_s - m.synthetic_s == pytest.approx(sum(real))
+
+    def test_overflow_still_accumulates_totals(self):
+        m = CostModel(max_signatures=2)
+        m.observe("decode", "decode/0", 0.01)
+        m.observe("decode", "decode/1", 0.01)
+        m.observe("decode", "decode/2", 0.01)  # overflows the table
+        assert m.overflow == 1
+        assert len(m.state()["signatures"]) == 2
+        assert m.total_s == pytest.approx(0.03)
+
+    def test_disabled_model_is_inert(self):
+        m = CostModel(False)
+        assert m.observe("decode", "decode/0", 0.01) is None
+        assert m.total_s == 0.0 and m.table() is None
+        assert m.state()["enabled"] is False
+
+    def test_table_and_by_kind_price_tokens(self):
+        m = CostModel()
+        feed(m, 10, 0.01, tokens=100)
+        feed(m, 10, 0.02, sig="prefill/8/1", kind="prefill",
+             tokens=1000, rows=8)
+        tab = m.table()
+        assert tab["decode/0"]["mean_s"] == pytest.approx(0.01)
+        assert tab["decode/0"]["us_per_token"] == pytest.approx(100.0)
+        assert tab["prefill/8/1"]["kind"] == "prefill"
+        by = m.by_kind()
+        assert by["decode"] == pytest.approx(100.0)
+        assert by["prefill"] == pytest.approx(20.0)
+        st = m.state()["signatures"]["prefill/8/1"]
+        assert st["us_per_row"] == pytest.approx(0.2 / 80 * 1e6)
+
+    def test_reset_forgets_everything(self):
+        m = CostModel(baseline_passes=2)
+        feed(m, 10, 0.01)
+        m.reset()
+        assert m.table() is None and m.total_s == 0.0
+        assert m.state()["signatures"] == {}
+
+
+# -------------------------------------------------------- AutoProfiler
+class TestAutoProfiler:
+    def make(self, **kw):
+        cap, clock = FakeCapture(), FakeClock()
+        kw.setdefault("passes", 3)
+        kw.setdefault("max_capture_s", 10.0)
+        kw.setdefault("debounce_s", 60.0)
+        return AutoProfiler(cap, clock=clock, **kw), cap, clock
+
+    def test_arm_and_pass_budget_auto_stop(self):
+        prof, cap, _ = self.make()
+        res = prof.arm("cost_drift", "pass cost drift: decode/0")
+        assert res and res["dir"] == "/fake/capture-1"
+        assert cap.starts == [10.0]  # bounded start, not unbounded
+        for _ in range(3):
+            prof.note_pass()
+        assert cap.stops == 1 and prof.captures == 1
+        art = prof.last_artifact
+        assert art["ok"] and art["reason"] == "cost_drift"
+        assert art["dir"] == "/fake/capture-1" and art["passes"] == 3
+        assert prof.state()["armed"] is None
+
+    def test_single_flight_refuses_a_second_arm(self):
+        prof, cap, _ = self.make()
+        assert prof.arm("cost_drift") is not None
+        assert prof.arm("fast_burn") is None
+        assert prof.suppressed == 1 and len(cap.starts) == 1
+
+    def test_debounce_gates_back_to_back_captures(self):
+        prof, cap, clock = self.make()
+        prof.arm("cost_drift")
+        for _ in range(3):
+            prof.note_pass()
+        assert prof.arm("cost_drift") is None  # clock has not moved
+        assert prof.debounced == 1
+        clock.advance(61.0)
+        assert prof.arm("cost_drift") is not None
+        assert len(cap.starts) == 2
+
+    def test_max_capture_s_stops_at_the_next_collect(self):
+        prof, cap, clock = self.make(passes=1000)
+        prof.arm("goodput_floor")
+        clock.advance(11.0)  # past max_capture_s
+        prof.note_pass()
+        assert cap.stops == 1 and prof.last_artifact["ok"]
+
+    def test_kill_switch_suppresses_arms(self, monkeypatch):
+        prof, cap, _ = self.make()
+        monkeypatch.setenv("GOFR_AUTOPROF", "0")
+        assert prof.arm("cost_drift") is None
+        assert prof.suppressed == 1 and not cap.starts
+        assert prof.state()["kill_switch"]
+        monkeypatch.setenv("GOFR_AUTOPROF", "1")
+        assert prof.arm("cost_drift") is not None
+
+    def test_no_capture_means_disabled(self):
+        prof = AutoProfiler(None)
+        assert not prof.enabled and prof.arm("cost_drift") is None
+        prof.note_pass()  # idle tick is a no-op, not an error
+
+    def test_refused_start_suppresses(self):
+        prof, cap, _ = self.make()
+        cap.refuse = True
+        assert prof.arm("cost_drift") is None and prof.suppressed == 1
+
+    def test_capture_watchdog_winning_the_stop_is_still_ok(self):
+        # ProfilerCapture's own max_capture_s timer may stop the trace
+        # before the pass budget runs out; the artifact was written, so
+        # the "no capture running" stop must not mark it failed
+        prof, cap, _ = self.make()
+        cap.stop_result = {"ok": False, "error": "no capture running"}
+        prof.arm("cost_drift")
+        for _ in range(3):
+            prof.note_pass()
+        assert prof.last_artifact["ok"]
+
+
+# ----------------------------------------------- ProfilerCapture hardening
+@pytest.fixture
+def fake_profiler(monkeypatch):
+    calls = {"start": 0, "stop": 0, "raise_on_stop": False}
+
+    def fake_start(path):
+        calls["start"] += 1
+
+    def fake_stop():
+        calls["stop"] += 1
+        if calls["raise_on_stop"]:
+            raise RuntimeError("No profile started")
+
+    import jax
+    monkeypatch.setattr(jax.profiler, "start_trace", fake_start)
+    monkeypatch.setattr(jax.profiler, "stop_trace", fake_stop)
+    return calls
+
+
+class TestProfilerCaptureHardening:
+    def test_stop_without_capture_reports_cleanly(self, tmp_path,
+                                                  fake_profiler):
+        from gofr_tpu.serving.observability import ProfilerCapture
+        cap = ProfilerCapture(base_dir=str(tmp_path))
+        res = cap.stop()
+        assert not res["ok"] and "no capture running" in res["error"]
+        assert fake_profiler["stop"] == 0
+
+    def test_force_stop_recovers_a_leaked_capture(self, tmp_path,
+                                                  fake_profiler):
+        # local state says idle, but JAX kept tracing (a crashed client
+        # never called stop): force must stop the underlying trace
+        from gofr_tpu.serving.observability import ProfilerCapture
+        cap = ProfilerCapture(base_dir=str(tmp_path))
+        res = cap.stop(force=True)
+        assert res["ok"] and res["recovered"] and res["dir"] is None
+        assert fake_profiler["stop"] == 1
+        # the next start works again
+        assert cap.start()["ok"]
+
+    def test_force_stop_swallows_the_stop_error(self, tmp_path,
+                                                fake_profiler):
+        from gofr_tpu.serving.observability import ProfilerCapture
+        cap = ProfilerCapture(base_dir=str(tmp_path))
+        assert cap.start()["ok"]
+        fake_profiler["raise_on_stop"] = True
+        res = cap.stop(force=True)
+        assert res["ok"] and res["recovered"]
+        assert not cap.status()["running"]
+
+    def test_plain_stop_still_surfaces_the_error(self, tmp_path,
+                                                 fake_profiler):
+        from gofr_tpu.serving.observability import ProfilerCapture
+        cap = ProfilerCapture(base_dir=str(tmp_path))
+        assert cap.start()["ok"]
+        fake_profiler["raise_on_stop"] = True
+        res = cap.stop()
+        assert not res["ok"] and "RuntimeError" in res["error"]
+
+    def test_max_capture_s_watchdog_auto_stops(self, tmp_path,
+                                               fake_profiler):
+        from gofr_tpu.serving.observability import ProfilerCapture
+        cap = ProfilerCapture(base_dir=str(tmp_path))
+        assert cap.start(max_capture_s=0.05)["ok"]
+        deadline = time.time() + 5.0
+        while cap.status()["running"] and time.time() < deadline:
+            time.sleep(0.01)
+        assert not cap.status()["running"]
+        assert cap.status()["auto_stops"] == 1
+        assert fake_profiler["stop"] == 1
+
+    def test_manual_stop_cancels_the_watchdog(self, tmp_path,
+                                              fake_profiler):
+        from gofr_tpu.serving.observability import ProfilerCapture
+        cap = ProfilerCapture(base_dir=str(tmp_path), max_capture_s=0.05)
+        assert cap.start()["ok"]
+        assert cap.stop()["ok"]
+        time.sleep(0.15)  # the expired timer must not double-stop
+        assert cap.status()["auto_stops"] == 0
+        assert fake_profiler["stop"] == 1
+
+
+# ------------------------------------------------------ cost_skew fault
+class TestCostSkewFault:
+    def test_parse_and_payload(self):
+        plan = FaultPlan.parse(
+            "cost_skew:at=7,times=0,seconds=0.5,request=decode/0")
+        assert plan.enabled
+        assert plan.payload("cost_skew") == pytest.approx(0.5)
+        assert plan.payload("pass_stall") == 0.0
+
+    def test_signature_scoped_deterministic_trigger(self):
+        plan = FaultPlan.parse(
+            "cost_skew:at=3,times=2,seconds=0.1,request=decode/0")
+        # other signatures never count toward the trigger
+        assert not any(plan.trip("cost_skew", "prefill/8/1")
+                       for _ in range(10))
+        hits = [plan.trip("cost_skew", "decode/0") for _ in range(6)]
+        assert hits == [False, False, True, True, False, False]
+
+
+# --------------------------------------------- replay cost divergence
+class TestCostDivergence:
+    REC = {"decode/0": {"kind": "decode", "n": 50, "mean_s": 0.010},
+           "prefill/8/1": {"kind": "prefill", "n": 9, "mean_s": 0.040}}
+
+    def test_flags_only_the_regressed_signature(self):
+        rep = {"decode/0": {"kind": "decode", "n": 50, "mean_s": 0.030},
+               "prefill/8/1": {"kind": "prefill", "n": 9,
+                               "mean_s": 0.041}}
+        out = cost_divergence(self.REC, rep)
+        assert [d["signature"] for d in out] == ["decode/0"]
+        assert out[0]["ratio"] == pytest.approx(3.0)
+        assert out[0]["kind"] == "decode"
+
+    def test_floor_suppresses_microsecond_jitter(self):
+        rec = {"decode/0": {"kind": "decode", "n": 5, "mean_s": 0.0001}}
+        rep = {"decode/0": {"kind": "decode", "n": 5, "mean_s": 0.0004}}
+        assert cost_divergence(rec, rep) == []
+
+    def test_missing_tables_are_silent(self):
+        assert cost_divergence(None, self.REC) == []
+        assert cost_divergence(self.REC, None) == []
+        assert cost_divergence(self.REC, {}) == []

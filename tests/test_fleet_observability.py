@@ -279,6 +279,69 @@ def test_straggler_detection_flags_skewed_host_and_warns():
         assert len(warns2) == len(warns)
 
 
+def test_signature_normalized_straggler_names_the_kernel():
+    """With federated cost tables the leader compares hosts on the
+    SAME dispatch signature: a host that is genuinely slow on a shared
+    kernel is flagged (and the signature named), while a host whose
+    p95 is fat only because it serves a heavier shape mix is NOT — the
+    exact confusion the raw max/median-p95 heuristic can't avoid."""
+    log = MockLogger()
+    leader, build = make_leader(logger=log,
+                                fleet=FleetConfig(straggler_ratio=1.5))
+    summaries = {
+        # the reference host: normal mix, normal costs
+        "fast": {"pass_p95_s": 0.010, "occupancy_mean": 2.0,
+                 "costs": {
+                     "decode/0": {"kind": "decode", "n": 50,
+                                  "mean_s": 0.010},
+                     "prefill/8/1": {"kind": "prefill", "n": 9,
+                                     "mean_s": 0.040}}},
+        # fattest p95 in the fleet — but only because it serves the
+        # long-context window; its SHARED signature costs are normal
+        "heavy-mix": {"pass_p95_s": 0.200, "occupancy_mean": 2.0,
+                      "costs": {
+                          "decode/0": {"kind": "decode", "n": 50,
+                                       "mean_s": 0.011},
+                          "decode/2048": {"kind": "decode", "n": 40,
+                                          "mean_s": 0.190}}},
+        # modest p95, but 3x the fleet median on the shared decode
+        # kernel — the actual straggler
+        "slow-kernel": {"pass_p95_s": 0.033, "occupancy_mean": 2.0,
+                        "costs": {
+                            "decode/0": {"kind": "decode", "n": 50,
+                                         "mean_s": 0.033},
+                            "prefill/8/1": {"kind": "prefill", "n": 9,
+                                            "mean_s": 0.041}}},
+    }
+    with AppRunner(build=build) as runner:
+        agents = {}
+        for host, summary in summaries.items():
+            agents[host] = WorkerAgent(
+                f"http://127.0.0.1:{runner.port}", host_id=host,
+                heartbeat_interval_s=0.1,
+                summary_source=lambda s=summary: s)
+            agents[host].join()
+        for agent in agents.values():
+            agent._heartbeat_once()
+        status, body = runner.get_json("/debug/fleet")
+        fleet = body["data"]["fleet"]
+        assert fleet["straggler_mode"] == "signature"
+        assert fleet["stragglers"] == ["slow-kernel"]
+        assert fleet["straggler_signatures"] == {
+            "slow-kernel": "decode/0"}
+        # decode/2048 has one reporter, so it never enters the compare
+        assert "decode/2048" not in fleet["costs"]["signatures"]
+        assert fleet["costs"]["signatures"]["decode/0"] == \
+            pytest.approx(0.011)
+        assert sorted(fleet["costs"]["hosts"]) == \
+            ["fast", "heavy-mix", "slow-kernel"]
+        # the WARN names the kernel, not just the host
+        warns = [ln for ln in log.lines
+                 if "straggler" in str(ln.get("message", ""))]
+        assert warns and warns[0]["host"] == "slow-kernel"
+        assert warns[0]["signature"] == "decode/0"
+
+
 # ------------------------------------------------------- trace stitching
 def test_control_rpcs_stitch_one_trace_across_hosts():
     leader, build = make_leader()
