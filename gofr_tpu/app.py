@@ -529,6 +529,20 @@ class App:
             return out
         self.get("/debug/costs", costs_debug)
 
+        def integrity_debug(ctx):
+            """Output-integrity observatory per served model: digest
+            fold totals, the sealed golden corpus, golden canary probe
+            results and the mismatch-episode latch — the 'a host is
+            returning garbage' runbook (docs/operations.md) starts
+            here. Fleet-wide divergence votes and quarantine live on
+            the leader's ``/debug/fleet``."""
+            out = {}
+            for model_name, engine in container.models.items():
+                out[model_name] = engine.integrity_state() \
+                    if hasattr(engine, "integrity_state") else None
+            return out
+        self.get("/debug/integrity", integrity_debug)
+
         def usage_debug(ctx):
             """Per-tenant usage rollup: ``?tenant=`` filters,
             ``?window=5m`` sums over the recent-event ring instead of

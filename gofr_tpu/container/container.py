@@ -269,6 +269,16 @@ class Container:
                       "control messages refused because they carried "
                       "a higher epoch than this leader holds (a "
                       "revived stale leader being fenced)")
+        # output-integrity quarantine series (serving/control_plane.py
+        # _vote_integrity): divergence-vote outcomes, control-plane
+        # cadence only
+        m.new_gauge("app_fleet_quarantined_hosts",
+                    "hosts currently quarantined by the integrity "
+                    "divergence vote (routed share held at zero until "
+                    "they rejoin)")
+        m.new_counter("app_fleet_quarantines",
+                      "integrity-divergence quarantine actions "
+                      "(by action label: quarantine/rejoin)")
         # tenant metering + SLO series, written by the usage ledger /
         # SLO tracker (serving/observability.py) at request retire;
         # tenant-labeled counters SUM across hosts under federation

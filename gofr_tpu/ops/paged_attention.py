@@ -832,10 +832,19 @@ def paged_tree_attention_xla(q: jnp.ndarray, k_pool,
     from .attention import tree_attention
     k_view = _slot_view(k_pool, tables)
     v_view = _slot_view(v_pool, tables)
-    return tree_attention(q, k_view, v_view,
-                          history_lens=history_lens,
-                          chunk_lens=chunk_lens,
-                          tree_masks=tree_masks, scale=scale)
+    out = tree_attention(q, k_view, v_view,
+                         history_lens=history_lens,
+                         chunk_lens=chunk_lens,
+                         tree_masks=tree_masks, scale=scale)
+    # zero-length slots (hist == clen == 0): every position is masked
+    # and the dense softmax degrades to a uniform average over garbage
+    # — the kernel's denom clamp returns exact zeros there. Match it
+    # so kernel and fallback agree on every row of every slot (the
+    # decode and chunk fallbacks above already do; this parity is what
+    # lets output digests compare across implementations bit-for-bit).
+    total = history_lens + chunk_lens
+    return jnp.where(total[:, None, None, None] > 0, out,
+                     jnp.zeros_like(out))
 
 
 def paged_tree_attention(q: jnp.ndarray, k_pool,

@@ -73,6 +73,19 @@ summaries, exposes them as ``app_fleet_pass_skew`` /
 and WARN-logs the offending host when skew crosses
 ``FleetConfig.straggler_ratio``.
 
+Integrity divergence voting: heartbeat summaries also carry each
+host's golden-canary probe digests (serving/integrity.py). With
+``FleetConfig.integrity_quorum`` or more hosts reporting a digest for
+the same golden probe the leader majority-votes — an on-host probe
+cannot catch corruption that also corrupted its sealed expectation,
+but the fleet majority can. A minority host is QUARANTINED: the
+routing view stops advertising it UP (the data-plane router drops it
+and fails in-flight work over via typed retries), one
+``fleet.integrity_divergence`` event + incident bundle opens per
+episode, and the host rejoins after
+``FleetConfig.integrity_clean_probes`` consecutive new agreeing
+probes. See docs/operations.md "A host is returning garbage".
+
 Cross-host trace stitching: join/heartbeat RPCs carry ``traceparent``
 (the worker wraps each RPC in a ``control.*`` span; the service client
 injects the header; the leader's tracing middleware continues the
@@ -157,6 +170,15 @@ class FleetConfig:
     #: the candidate discovery walk (typed stale_leader / not_leader
     #: evidence fails over immediately, without waiting this out)
     missed_acks_before_failover: int = 3
+    #: minimum hosts reporting a digest for the SAME golden probe
+    #: before the leader majority-votes on it (integrity divergence
+    #: detection needs a tie-breaker: with 2 hosts a mismatch names
+    #: nobody, with 3 the odd one out is the outlier)
+    integrity_quorum: int = 3
+    #: consecutive NEW (seq-advanced) majority-agreeing probe
+    #: observations a quarantined host must post before the leader
+    #: lifts the quarantine and the router routes to it again
+    integrity_clean_probes: int = 2
 
 
 def engine_fleet_sources(engine: Any) -> tuple[Callable[[], dict],
@@ -251,6 +273,9 @@ _FLEET_GAUGES = (
     ("app_fleet_leader_epoch",
      "this leader's election epoch (monotone across failovers; the "
      "fleet-wide max identifies the active leader)"),
+    ("app_fleet_quarantined_hosts",
+     "hosts currently quarantined by the integrity divergence vote "
+     "(routed traffic share held at zero until they rejoin)"),
 )
 _FLEET_COUNTERS = (
     ("app_fleet_evictions",
@@ -263,6 +288,10 @@ _FLEET_COUNTERS = (
     ("app_fleet_stale_leader_rejects",
      "control writes refused by epoch fencing: a revived stale "
      "leader rejecting (and demoting on) higher-epoch messages"),
+    ("app_fleet_quarantines",
+     "integrity-divergence quarantine actions (by action label: "
+     "quarantine when the vote names an outlier, rejoin when its "
+     "clean-probe streak clears it)"),
 )
 
 
@@ -308,6 +337,12 @@ class ControlPlaneLeader:
         self.generation = 0
         self._members: dict[str, _Member] = {}
         self._stragglers: set[str] = set()
+        #: hosts quarantined by the integrity divergence vote:
+        #: host_id -> {golden_id, digest, majority, voters, last_seq,
+        #: clean}. Membership here IS the episode latch — the
+        #: divergence event/bundle fire exactly once, on entry — and
+        #: the routing view reports these hosts QUARANTINED
+        self._quarantined: dict[str, dict] = {}
         self._lock = threading.Lock()
         self._sweeper: threading.Thread | None = None
         self._running = False
@@ -315,6 +350,11 @@ class ControlPlaneLeader:
         #: group for any reason (leave, sweep, degraded, scale_down) —
         #: the fleet router drops its session-affinity entries here
         self.evict_listeners: list = []
+        #: callbacks (host_id, action) fired on integrity quarantine
+        #: transitions, action in {"quarantine", "rejoin"} — the fleet
+        #: router drops affinity to a quarantined host and counts the
+        #: action in its debug state
+        self.quarantine_listeners: list = []
         #: extra named () -> dict blocks merged into fleet_status()
         #: (``/debug/fleet``) — the router publishes its state here
         self.status_sources: dict[str, Any] = {}
@@ -554,6 +594,7 @@ class ControlPlaneLeader:
             self.evict(host_id, reason="degraded")
             return None, True
         self._recompute_skew()
+        self._vote_integrity()
         return assignment, changed
 
     def evict(self, host_id: str, reason: str = "manual") -> None:
@@ -562,6 +603,9 @@ class ControlPlaneLeader:
                 return
             self.generation += 1
             self._stragglers.discard(host_id)
+            # an evicted host's quarantine episode ends with it — a
+            # rejoin starts from a clean slate (fresh digests re-vote)
+            self._quarantined.pop(host_id, None)
         self._set_membership_gauges()
         if self.metrics is not None:
             self.metrics.increment_counter("app_fleet_evictions",
@@ -582,13 +626,21 @@ class ControlPlaneLeader:
     def add_evict_listener(self, fn: Any) -> None:
         self.evict_listeners.append(fn)
 
+    def add_quarantine_listener(self, fn: Any) -> None:
+        self.quarantine_listeners.append(fn)
+
     def routing_view(self) -> list[dict]:
         """Snapshot for the data-plane router: one dict per member
         with the address to dial, health status, and the latest
-        heartbeat summary (queue depth, pass timings, prefix digest)."""
+        heartbeat summary (queue depth, pass timings, prefix digest).
+        An integrity-quarantined host reports QUARANTINED here — the
+        router only routes to UP members, so quarantine needs no
+        router-side special case to stop traffic."""
         with self._lock:
             return [{"host_id": m.host_id, "address": m.address,
-                     "status": m.health.get("status", "UP"),
+                     "status": "QUARANTINED"
+                     if m.host_id in self._quarantined
+                     else m.health.get("status", "UP"),
                      "summary": dict(m.summary)}
                     for m in self._members.values()]
 
@@ -792,6 +844,160 @@ class ControlPlaneLeader:
                             "hosts": sorted(costs)}
         return out
 
+    # ------------------------------------------- integrity divergence
+    def _vote_integrity(self) -> dict:
+        """Majority-vote the golden-probe digests riding the heartbeat
+        summaries (serving/integrity.py): per golden probe id reported
+        by >= ``FleetConfig.integrity_quorum`` hosts, the strict-
+        majority digest is taken as fleet truth and a minority host is
+        the outlier — its own probe cannot catch corruption that also
+        corrupted its sealed expectation, but the fleet can. Naming an
+        outlier quarantines it (entry into ``_quarantined`` is the
+        once-per-episode latch: one ``fleet.integrity_divergence``
+        event + one incident bundle); a quarantined host rejoins after
+        ``integrity_clean_probes`` consecutive NEW (probe-seq
+        advanced) majority-agreeing observations. Leader-side digest
+        comparison at heartbeat cadence — counts only, no clocks, no
+        RNG, so a divergence drill reproduces under bisect."""
+        quorum = max(2, int(self.fleet.integrity_quorum))
+        clean_needed = max(1, int(self.fleet.integrity_clean_probes))
+        with self._lock:
+            reports: dict[str, dict] = {}
+            for h, m in self._members.items():
+                integ = m.summary.get("integrity")
+                if not isinstance(integ, Mapping):
+                    continue
+                probes = integ.get("probe_digests")
+                if not isinstance(probes, Mapping) or not probes:
+                    continue
+                reports[h] = {
+                    "digests": {str(g): str(d)
+                                for g, d in probes.items()},
+                    "seq": int(integ.get("seq") or 0)}
+        # ballot boxes: golden id -> {host: digest}
+        by_golden: dict[str, dict[str, str]] = {}
+        for host, rep in reports.items():
+            for gid, digest in rep["digests"].items():
+                by_golden.setdefault(gid, {})[host] = digest
+        votes: dict[str, dict] = {}
+        outliers: dict[str, str] = {}  # host -> golden id it lost on
+        agree: dict[str, bool] = {}    # host agreed with every verdict
+        for gid, ballots in sorted(by_golden.items()):
+            if len(ballots) < quorum:
+                continue  # not enough voters to break a tie
+            tally: dict[str, int] = {}
+            for digest in ballots.values():
+                tally[digest] = tally.get(digest, 0) + 1
+            winner = max(tally, key=lambda d: tally[d])
+            if tally[winner] * 2 <= len(ballots):
+                # no strict majority: the fleet itself disagrees —
+                # record the split, never guess an outlier from a tie
+                votes[gid] = {"majority": None, "tally": tally,
+                              "voters": len(ballots)}
+                continue
+            votes[gid] = {"majority": winner, "tally": tally,
+                          "voters": len(ballots)}
+            for host, digest in ballots.items():
+                if digest == winner:
+                    agree.setdefault(host, True)
+                else:
+                    agree[host] = False
+                    outliers.setdefault(host, gid)
+        newly: list[tuple[str, dict]] = []
+        rejoined: list[tuple[str, dict]] = []
+        with self._lock:
+            for host, gid in outliers.items():
+                rec = self._quarantined.get(host)
+                if rec is not None:
+                    # still dirty: restart the clean streak
+                    rec["clean"] = 0
+                    rec["last_seq"] = reports[host]["seq"]
+                    continue
+                rec = {"golden_id": gid,
+                       "digest": reports[host]["digests"][gid],
+                       "majority": votes[gid]["majority"],
+                       "voters": votes[gid]["voters"],
+                       "generation": self.generation,
+                       "last_seq": reports[host]["seq"],
+                       "clean": 0}
+                self._quarantined[host] = rec
+                newly.append((host, dict(rec)))
+            for host in list(self._quarantined):
+                if host in outliers or host not in agree:
+                    continue  # no fresh verdict on this host
+                rep = reports.get(host)
+                rec = self._quarantined[host]
+                # same probes as last round are not new evidence —
+                # the rejoin streak counts PROBES, not heartbeats
+                if rep is None or rep["seq"] <= rec.get("last_seq", -1):
+                    continue
+                rec["last_seq"] = rep["seq"]
+                rec["clean"] = rec.get("clean", 0) + 1
+                if rec["clean"] >= clean_needed:
+                    rejoined.append((host, self._quarantined.pop(host)))
+            quarantined = {h: dict(r)
+                           for h, r in self._quarantined.items()}
+        for host, rec in newly:
+            if self.metrics is not None:
+                self.metrics.increment_counter("app_fleet_quarantines",
+                                               action="quarantine")
+            if self.logger:
+                self.logger.warn(
+                    "host quarantined: golden-probe digest diverged "
+                    "from the fleet majority — routing stops until "
+                    "its clean-probe streak clears it",
+                    host=host, golden_id=rec["golden_id"],
+                    digest=rec["digest"], majority=rec["majority"],
+                    voters=rec["voters"])
+            self.events.emit(
+                "fleet.integrity_divergence", severity="error",
+                epoch=self.epoch, cause="probe_digest_minority",
+                outlier=host, golden_id=rec["golden_id"],
+                digest=rec["digest"], majority=rec["majority"],
+                voters=rec["voters"])
+            self.events.emit(
+                "fleet.quarantine", severity="warn",
+                epoch=self.epoch, cause="integrity_divergence",
+                quarantined=host, action="quarantine")
+            self.incidents.trigger(
+                "integrity_divergence", epoch=self.epoch,
+                cause=f"host {host} diverged from the fleet majority "
+                      f"on golden probe {rec['golden_id']}",
+                attrs=dict(rec, host=host))
+            for listener in list(self.quarantine_listeners):
+                try:
+                    listener(host, "quarantine")
+                except Exception:
+                    pass  # a broken listener must not block the vote
+        for host, rec in rejoined:
+            if self.metrics is not None:
+                self.metrics.increment_counter("app_fleet_quarantines",
+                                               action="rejoin")
+            if self.logger:
+                self.logger.info(
+                    "quarantined host rejoined: consecutive clean "
+                    "golden probes agreed with the fleet majority",
+                    host=host, clean=rec["clean"],
+                    golden_id=rec["golden_id"])
+            self.events.emit(
+                "fleet.quarantine", severity="info",
+                epoch=self.epoch, cause="clean_probes",
+                quarantined=host, action="rejoin",
+                clean=rec["clean"])
+            for listener in list(self.quarantine_listeners):
+                try:
+                    listener(host, "rejoin")
+                except Exception:
+                    pass
+        if self.metrics is not None:
+            self.metrics.set_gauge("app_fleet_quarantined_hosts",
+                                   float(len(quarantined)))
+        return {"quorum": quorum,
+                "clean_probes": clean_needed,
+                "reporting": sorted(reports),
+                "votes": votes,
+                "quarantined": quarantined}
+
     # ------------------------------------------------------ fleet views
     def fleet_status(self) -> dict:
         """The consolidated ``/debug/fleet`` JSON: per-host flight
@@ -802,7 +1008,8 @@ class ControlPlaneLeader:
             now = time.time()
             hosts = {
                 h: {"rank": ranks[h], "address": m.address,
-                    "status": m.health.get("status", "UP"),
+                    "status": "QUARANTINED" if h in self._quarantined
+                    else m.health.get("status", "UP"),
                     "health": dict(m.health),
                     "last_seen_age_s": round(now - m.last_seen, 3),
                     "summary": dict(m.summary),
@@ -836,6 +1043,7 @@ class ControlPlaneLeader:
                                      + float(s.get("value", 0.0)), 6)
         out = {"generation": generation, "world_size": world,
                "fleet": self._recompute_skew(), "hosts": hosts,
+               "integrity": self._vote_integrity(),
                "counter_totals": totals,
                "tenant_usage": tenant_usage}
         for name, source in self.status_sources.items():

@@ -134,6 +134,17 @@ class GenRequest:
                                    # prefill recomputes KV it already
                                    # paid for once, priced under the
                                    # preempt_recompute goodput cause
+    digest: str | None = None      # output fingerprint, stamped once at
+                                   # the retire boundary by the
+                                   # integrity plane's digest fold
+                                   # (serving/integrity.py)
+    probe: str = ""                # golden-canary id when this request
+                                   # IS an integrity probe — its device
+                                   # time re-prices to integrity_probe
+                                   # waste and its digest is judged
+                                   # against probe_expected at retire
+    probe_expected: str = ""       # the sealed golden digest a probe
+                                   # must reproduce bit-for-bit
 
     def _emit(self, token: int | None) -> None:
         if self.out_queue is not None and self.loop is not None:
@@ -420,6 +431,28 @@ class EngineConfig:
     #: throttled gauge cadence once busy_s > 1); 0 disables the floor
     autoprof_goodput_floor: float = 0.0
     autoprof_dir: str = "/tmp/gofr_tpu_profiles"
+    #: output-integrity observatory (serving/integrity.py): fold every
+    #: retired request into a blake2b fingerprint at the retire
+    #: boundary — stamped into GenRequest/flight recorder/workload
+    #: records and judged by golden canary probes + fleet divergence
+    #: voting. Zero hot-path perturbation: greedy outputs stay
+    #: bit-identical with the plane ON.
+    integrity: bool = True
+    #: golden canary corpus (gofr-golden JSONL sealed from the replay
+    #: corpus by GoldenSet.seal) — None disables probing; the
+    #: fingerprint fold alone needs no corpus
+    integrity_golden_path: str | None = None
+    #: cap on golden entries loaded/probed (the corpus is meant to be
+    #: tiny — a handful of short greedy prompts)
+    integrity_golden_max: int = 8
+    #: launch one golden probe on the scheduler's background lane
+    #: every N collected passes (pass-count cadence, never wall
+    #: clock); 0 disables probing
+    integrity_probe_passes: int = 0
+    #: consecutive clean probes that close a mismatch episode so a
+    #: later mismatch alarms again (hysteresis, mirroring the
+    #: cost-drift sentinel)
+    integrity_rearm_probes: int = 2
     #: admission/scheduling/shedding policy (serving/scheduler.py):
     #: weighted fair-share dequeue over per-tenant sub-queues,
     #: interactive/background lanes with starvation preemption,
@@ -517,6 +550,26 @@ class Engine:
             passes=config.autoprof_passes,
             max_capture_s=config.autoprof_max_capture_s,
             debounce_s=config.autoprof_debounce_s, logger=logger)
+        #: output-integrity observatory: digest folds at the retire
+        #: boundary, golden canary probes on the background lane at a
+        #: pass-count cadence, heartbeat digest block for the leader's
+        #: divergence vote (serving/integrity.py)
+        from .integrity import GoldenSet, IntegrityPlane
+        _golden = None
+        if config.integrity and config.integrity_golden_path:
+            # a missing/corrupt corpus must fail at construction, not
+            # silently disable probing mid-incident
+            _golden = GoldenSet.load(config.integrity_golden_path,
+                                     limit=config.integrity_golden_max)
+        self.integrity = IntegrityPlane(
+            config.integrity, golden=_golden,
+            probe_passes=config.integrity_probe_passes,
+            rearm_probes=config.integrity_rearm_probes)
+        if self.integrity.enabled:
+            # heartbeat summaries carry the probe digests: the
+            # leader's divergence vote compares hosts on the SAME
+            # golden prompt
+            self.recorder.integrity_source = self.integrity.summary
         if self.goodput.enabled:
             # heartbeats and workload headers carry the waste digest
             self.recorder.goodput_source = self.goodput.summary
@@ -869,6 +922,9 @@ class Engine:
             # where is the trace") — the cost_drift reason's bundle
             # additionally carries the capture dir in its attrs
             "costs": self.cost_state,
+            # ... and the integrity plane's probe/episode state, so an
+            # integrity bundle names which golden prompt diverged
+            "integrity": self.integrity_state,
         })
         # crash-recovery supervisor state (see _recover / RestartPolicy)
         self._restarts = 0
@@ -1005,7 +1061,8 @@ class Engine:
                       "spec_accepted": 0, "spec_drafted": 0,
                       "spec_rows": 0, "preemptions": 0,
                       "requeues": 0, "prefix_evictions": 0,
-                      "stalls": 0, "recompiles": 0, "cost_drifts": 0}
+                      "stalls": 0, "recompiles": 0, "cost_drifts": 0,
+                      "integrity_failures": 0}
         #: waste-counter watermark already published to the metrics
         #: manager (the throttled gauge pass emits deltas)
         self._waste_published: dict[str, float] = {}
@@ -1316,6 +1373,10 @@ class Engine:
              "pass-cost drift episodes by dispatch kind: a signature's "
              "cost EWMA departed its sealed baseline past the "
              "configured ratio/sigma thresholds (serving/costmodel.py)"),
+            ("app_engine_integrity_failures",
+             "golden canary probe digest mismatch episodes by kind: "
+             "this host produced output whose fingerprint departed the "
+             "sealed golden digest (serving/integrity.py)"),
             ("app_engine_restarts",
              "engine loop restarts by the in-thread crash-recovery "
              "supervisor (EngineConfig.restart_policy)"),
@@ -1850,6 +1911,9 @@ class Engine:
         sampled token and open the slot for decode."""
         self._sched_dirty = True  # slot flips pending -> decoding
         req.pending_prefill = False
+        if self.faults is not NO_FAULTS and \
+                self.faults.trip("logit_corrupt", req.tenant):
+            first = self._corrupt_token(first)
         now = time.time()  # gofrlint: allow(hot-path-purity) -- first-token boundary of a finished walk: once per request lifetime
         if req.first_token_at is None:  # not a preemption recompute
             req.first_token_at = now
@@ -2457,8 +2521,14 @@ class Engine:
         episode entry (CostModel.observe returns a record once per
         episode) emits obs.cost_drift, WARNs once, bumps
         app_engine_cost_drift{kind}, arms the autoprofiler and opens a
-        cost_drift incident bundle carrying the capture dir."""
+        cost_drift incident bundle carrying the capture dir. The
+        integrity plane's probe cadence ticks here too — one int
+        compare per pass when probing is off, a background-lane submit
+        when it fires (pass-count-driven, never wall clock)."""
         self.autoprof.note_pass()
+        probe = self.integrity.note_pass()
+        if probe is not None:
+            self._launch_probe(probe)
         if not self.costs.enabled:
             return
         skew = 0.0
@@ -2498,6 +2568,100 @@ class Engine:
         source, so every bundle names which kernel class got slower."""
         return {"costs": self.costs.state(),
                 "autoprof": self.autoprof.state()}
+
+    def integrity_state(self) -> dict:
+        """The per-model ``GET /debug/integrity`` payload: digest-fold
+        totals, golden corpus, probe results and the mismatch-episode
+        latch — also an incident-bundle source, so an integrity bundle
+        names which golden prompt diverged."""
+        return self.integrity.state()
+
+    def _launch_probe(self, entry) -> None:
+        """Submit one golden canary through the normal admission path
+        on the scheduler's BACKGROUND lane — a probe must never crowd
+        out interactive traffic (it yields to it by lane policy), and
+        it must exercise exactly the serving path users ride, or a
+        clean probe would prove nothing. The GenRequest is built
+        directly (not via ``submit``) so the probe marker is stamped
+        before any admission refusal can retire the request."""
+        p = entry.params
+        params = SamplingParams(temperature=p["temperature"],
+                                top_p=p["top_p"], top_k=p["top_k"],
+                                max_new_tokens=p["max_new_tokens"])
+        req = GenRequest(
+            prompt_tokens=self._clamp_prompt(list(entry.prompt_tokens),
+                                             params.max_new_tokens),
+            params=params, tenant="_integrity", lane="background")
+        req.probe = entry.id
+        req.probe_expected = entry.digest
+        if self._draining or not self.waiting.put(req):
+            # refused at admission (drain window, queue_full, shed):
+            # release the in-flight latch — the cadence retries later
+            self.integrity.probe_aborted()
+
+    @hot_path_boundary(
+        "integrity fold at the retire boundary: one blake2b over token "
+        "ids the collects already emitted plus host dict bookkeeping "
+        "for probe results; the WARN/event/metric/incident fire only "
+        "on a rare probe-mismatch episode entry — runs once per "
+        "request, never per pass")
+    def _note_integrity(self, req: GenRequest) -> None:
+        """Feed one retired request to the integrity plane: stamp the
+        output fingerprint (flight recorder and workload records pick
+        it up downstream in ``_finalize_obs``), re-price golden-probe
+        device time to the ``integrity_probe`` waste cause, emit the
+        probe's ``obs.integrity`` event, and on a mismatch episode
+        entry (IntegrityPlane.fold returns a record once per episode)
+        WARN once, bump ``app_engine_integrity_failures{kind}`` and
+        open an incident bundle."""
+        mismatch = self.integrity.fold(req)
+        if req.probe:
+            # canary device time is correctness verification, not
+            # serving goodput — move it to the conserving ledger's
+            # integrity_probe cause (busy unchanged)
+            self.goodput.reprice_probe(req.device_s)
+            self.integrity.probe_device_s += req.device_s
+            rec = self.integrity.last.get(req.probe)
+            if rec is not None and req.error is None \
+                    and not req.cancelled:
+                self.events.emit(
+                    "obs.integrity",
+                    severity="info" if rec["ok"] else "warn",
+                    golden_id=req.probe, digest=rec["digest"],
+                    expected=req.probe_expected, ok=rec["ok"],
+                    seq=rec["seq"])
+        if mismatch is None:
+            return
+        self.stats["integrity_failures"] += 1
+        if self.metrics is not None:
+            self.metrics.increment_counter(
+                "app_engine_integrity_failures", kind="probe_mismatch")
+        if self.logger is not None:
+            self.logger.warn(
+                "golden probe digest mismatch: this host's greedy "
+                "output diverged from its sealed expectation",
+                golden_id=mismatch["golden_id"],
+                digest=mismatch["digest"],
+                expected=mismatch["expected"])
+        self.incidents.trigger(
+            "integrity",
+            cause=f"golden probe digest mismatch: "
+                  f"{mismatch['golden_id']}",
+            attrs=dict(mismatch))
+
+    def _corrupt_token(self, token: int) -> int:
+        """The ``logit_corrupt`` fault site's host-visible effect: the
+        device's sampled token is replaced deterministically, as a
+        corrupted logit row would have sampled a different id (the
+        real logits never cross to the host — the zero-h2d invariant —
+        so the collected token IS where device corruption becomes
+        observable). The perturbed id never lands on ``eos_id``:
+        stream lengths are preserved, nothing crashes, only digests
+        diverge."""
+        alt = token ^ 1
+        if alt == self.config.eos_id:
+            alt = token ^ 2
+        return alt
 
     def _note_device_idle(self) -> None:
         """Goodput bubble tracking: a synchronous collect finished and
@@ -2573,8 +2737,12 @@ class Engine:
                 waste_recompute_s=req.waste_recompute_s,
                 waste_spec_s=req.waste_spec_s, t=end)
         if self.slo is not None and not req.cancelled \
-                and getattr(req, "reject", None) is None:
-            # typed admission refusals (429/shed) are policy, not
+                and getattr(req, "reject", None) is None \
+                and not req.probe:
+            # golden canary probes are synthetic traffic: a corrupted
+            # host's probes must alarm the INTEGRITY plane, not burn
+            # the availability error budget into a shed episode.
+            # Likewise, typed admission refusals (429/shed) are policy, not
             # service failures: counting them as SLO errors would let
             # one tenant's flood burn the global budget and trip the
             # shedder against everyone else (a rejection -> burn ->
@@ -2587,10 +2755,17 @@ class Engine:
             # column (the /debug/scheduler victim/offender view)
             if hasattr(self.waiting, "note_retire"):
                 self.waiting.note_retire(req.tenant, good, t=end)
+        if self.integrity.enabled:
+            # digest fold BEFORE the recorder/workload writes below,
+            # so both records carry the fingerprint
+            self._note_integrity(req)
         if self.recorder.enabled:
             from .observability import request_summary
             self.recorder.record_request(request_summary(req))
-        if self.workload.capturing:
+        if self.workload.capturing and not req.probe:
+            # golden probes stay out of the capture ring: the replay
+            # corpus (and any golden set sealed from it) must hold
+            # real traffic, not the canaries checking it
             self.workload.record(req)
         if self.tracer is not None and req.trace is not None:
             try:
@@ -2864,6 +3039,9 @@ class Engine:
                                 now, {"bucket": rec.get("bucket"),
                                       "rows": len(rec["placed"])})
                 first = int(toks_np[row])
+                if self.faults is not NO_FAULTS and \
+                        self.faults.trip("logit_corrupt", req.tenant):
+                    first = self._corrupt_token(first)
                 if req.first_token_at is None:  # not a recompute
                     req.first_token_at = now
                     if self.metrics is not None:
@@ -3227,6 +3405,9 @@ class Engine:
             done = False
             for k in range(int(rec["valid"][i])):
                 token = int(step_np[k, i])
+                if self.faults is not NO_FAULTS and \
+                        self.faults.trip("logit_corrupt", req.tenant):
+                    token = self._corrupt_token(token)
                 req.generated.append(token)
                 req._emit(token)
                 self.total_generated += 1
@@ -3632,6 +3813,9 @@ class Engine:
                 if kept >= ceiling:
                     done = True
                     break
+                if self.faults is not NO_FAULTS and \
+                        self.faults.trip("logit_corrupt", req.tenant):
+                    token = self._corrupt_token(token)
                 req.generated.append(token)
                 req._emit(token)
                 self.total_generated += 1

@@ -84,6 +84,10 @@ KINDS = frozenset({
     # observability.py / costmodel.py — efficiency sentinels
     "obs.recompile", "obs.watermark", "obs.fast_burn",
     "obs.cost_drift",
+    # integrity.py — output-integrity observatory: golden-probe
+    # results/mismatch episodes (engine side) and the leader's
+    # divergence-vote verdicts + quarantine/rejoin actions
+    "obs.integrity", "fleet.integrity_divergence", "fleet.quarantine",
     # events.py itself — an incident bundle was spooled
     "incident.open",
 })
@@ -454,7 +458,13 @@ class IncidentDetector:
     (**restart_budget**), or a dispatch signature's pass cost departing
     its sealed baseline (**cost_drift** — serving/costmodel.py; the
     bundle's ``costs`` source carries the per-signature table and the
-    auto-captured profiler artifact path rides the trigger attrs).
+    auto-captured profiler artifact path rides the trigger attrs), a
+    golden canary probe whose output fingerprint departed its sealed
+    digest (**integrity** — serving/integrity.py; the bundle's
+    ``integrity`` source names which golden prompt diverged), or the
+    leader's divergence vote naming an outlier host
+    (**integrity_divergence** — the fleet-side bundle carries the
+    vote, the outlier and the quarantine action).
 
     The bundle is assembled from pluggable zero-arg ``sources`` (slo /
     scheduler / watermarks / goodput / recorder / config blocks — a
@@ -465,7 +475,8 @@ class IncidentDetector:
     3am page links to a bundle that, by the time a human opens it,
     covers both sides of the incident."""
 
-    REASONS = ("fast_burn", "failover", "restart_budget", "cost_drift")
+    REASONS = ("fast_burn", "failover", "restart_budget", "cost_drift",
+               "integrity", "integrity_divergence")
 
     def __init__(self, config: EventLedgerConfig | None = None, *,
                  ledger: EventLedger | None = None, host: str = "",

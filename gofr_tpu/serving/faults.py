@@ -48,6 +48,15 @@ view):
                           dispatch signature in ``request`` — deterministic
                           drift induction with zero sleep and zero token
                           perturbation (greedy outputs stay bit-identical)
+``logit_corrupt``         report-only: the engine perturbs a collected token
+                          at the emit boundary — the host-visible consequence
+                          of corrupted device logits (the real logits never
+                          cross to the host). Nothing crashes, stream lengths
+                          are preserved, but output digests diverge — the CI
+                          driver for the integrity observatory
+                          (serving/integrity.py). Scope to one request class
+                          with ``request=<tenant>``; scope to one host by
+                          arming only that host's plan
 ========================  =====================================================
 
 The disabled plan is the module-level :data:`NO_FAULTS` singleton; call
@@ -83,7 +92,7 @@ SITES = frozenset({
     "pass_raise", "pass_stall", "pass_latency", "page_exhaustion",
     "nan_logits", "heartbeat_drop", "join_refused",
     "leader_down", "leader_partition", "ack_drop", "stale_epoch_replay",
-    "cost_skew",
+    "cost_skew", "logit_corrupt",
 })
 
 # sites whose firing is a raise vs. a sleep; the rest report True and
@@ -169,7 +178,7 @@ class FaultPlan:
         covers it. Raises :class:`InjectedFault` for the raise sites,
         sleeps for the stall/latency sites, returns True for the
         report-only sites (page_exhaustion / heartbeat_drop /
-        join_refused / cost_skew)."""
+        join_refused / cost_skew / logit_corrupt)."""
         specs = self._by_site.get(site)
         if not specs:
             return False

@@ -60,6 +60,11 @@ class FlightRecorder:
         #: SAME compiled graph (signature-normalized straggler math)
         #: instead of the workload-mix-confounded p95
         self.cost_source: Any = None
+        #: optional () -> integrity digest block
+        #: (IntegrityPlane.summary); rides fleet_summary so the leader
+        #: can majority-vote golden-probe digests across hosts and
+        #: quarantine the outlier (serving/integrity.py)
+        self.integrity_source: Any = None
 
     # ------------------------------------------------------------ writers
     def record_pass(self, kind: str, **fields: Any) -> None:
@@ -143,6 +148,13 @@ class FlightRecorder:
                 costs = None
             if costs:
                 out["costs"] = costs
+        if self.integrity_source is not None:
+            try:
+                integ = self.integrity_source()
+            except Exception:
+                integ = None
+            if integ:
+                out["integrity"] = integ
         return out
 
     def dump(self, logger: Any, reason: str = "") -> None:
@@ -173,6 +185,7 @@ def request_summary(req: Any) -> dict:
         "ttft_ms": round(req.ttft_ms, 3) if req.ttft_ms is not None else None,
         "error": req.error,
         "cancelled": req.cancelled,
+        "digest": getattr(req, "digest", None),
         "events": [{"name": name, "t0": t0, "t1": t1, **(attrs or {})}
                    for name, t0, t1, attrs in req.events],
     }
@@ -208,6 +221,11 @@ class GoodputMeter:
       waiting (queued, requeued or active). Host scheduling overhead
       the device spends idle — the dispatch-bound regime BENCH_r05
       measured, now a named number.
+    - ``integrity_probe`` — device time spent serving golden canary
+      probes (serving/integrity.py): correct-by-design synthetic
+      traffic, re-priced out of ``useful`` at the probe's retire
+      (:meth:`reprice_probe`) so correctness verification is never
+      mistaken for serving goodput.
 
     Everything is engine-thread float arithmetic at dispatch/collect —
     the same single-writer discipline as the FlightRecorder; no locks,
@@ -217,7 +235,8 @@ class GoodputMeter:
     time — it is an attribution base, not a wall clock.
     """
 
-    CAUSES = ("padding", "preempt_recompute", "spec_rejected", "bubble")
+    CAUSES = ("padding", "preempt_recompute", "spec_rejected", "bubble",
+              "integrity_probe")
 
     def __init__(self, enabled: bool = True) -> None:
         self.enabled = bool(enabled)
@@ -297,6 +316,26 @@ class GoodputMeter:
         self._account("spec_verify", busy, useful,
                       spec_rejected=rejected,
                       padding=max(0, batch - len(rows)) * share)
+
+    def reprice_probe(self, device_s: float) -> None:
+        """Re-price a retired golden probe's attributed device time
+        from ``useful`` to the ``integrity_probe`` waste cause —
+        ``busy_s`` unchanged, so the conservation identity stays
+        structural. The transfer lands in ``by_kind`` as a dedicated
+        ``integrity_probe`` journal row (zero busy, negative useful)
+        so per-kind sums still reconcile against the totals."""
+        if not self.enabled or device_s <= 0:
+            return
+        moved = min(float(device_s), self.useful_s)
+        if moved <= 0:
+            return
+        self.useful_s -= moved
+        self.waste_s["integrity_probe"] += moved
+        sub = self.by_kind.setdefault(
+            "integrity_probe", {"busy_s": 0.0, "useful_s": 0.0,
+                                **{c: 0.0 for c in self.CAUSES}})
+        sub["useful_s"] -= moved
+        sub["integrity_probe"] += moved
 
     def note_pass_end(self, t: float, backlog: bool) -> None:
         """The device went idle at host time ``t`` (a collect finished
@@ -592,6 +631,11 @@ class WorkloadRecorder:
         else:
             rec["prompt_tokens"] = list(req.prompt_tokens)
             rec["completion_tokens"] = list(req.generated)
+        if getattr(req, "digest", None):
+            # the output fingerprint (serving/integrity.py): additive
+            # record field so replay can diff recorded vs replayed
+            # digests (the digest_divergence report key)
+            rec["digest"] = req.digest
         if req.error is not None:
             rec["error"] = str(req.error)[:200]
         if req.ttft_ms is not None:

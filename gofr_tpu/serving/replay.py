@@ -266,6 +266,7 @@ def replay_workload(engine: Any, workload: dict, *, speed: float = 1.0,
 
     # ------------------------------------------------------ divergence
     divergences: list = []
+    digest_divergences: list = []
     compared = replay_errors = 0
     for idx, (rec, req) in enumerate(pairs):
         if rec.get("status") != "ok":
@@ -285,6 +286,20 @@ def replay_workload(engine: Any, workload: dict, *, speed: float = 1.0,
                                                            replayed),
                 "recorded_len": len(recorded),
                 "replayed_len": len(replayed)})
+        # fingerprint twin of the token diff: the integrity plane
+        # stamps a digest on both the capture and the replayed
+        # request (serving/integrity.py). A digest mismatch with
+        # MATCHING tokens means the fingerprint inputs drifted
+        # (params quantization, digest version) — worth naming, since
+        # golden probes sealed from the capture would now misfire.
+        # Advisory only, never a gate.
+        rec_digest = rec.get("digest")
+        rep_digest = getattr(req, "digest", None)
+        if rec_digest and rep_digest and rec_digest != rep_digest:
+            digest_divergences.append({
+                "index": idx, "recorded": rec_digest,
+                "replayed": rep_digest,
+                "tokens_match": recorded == replayed})
     metrics = getattr(engine, "metrics", None)
     if metrics is not None and divergences:
         if metrics.get("app_replay_divergence") is None:
@@ -353,6 +368,10 @@ def replay_workload(engine: Any, workload: dict, *, speed: float = 1.0,
         "replayed_costs": replayed_costs,
         "cost_divergence": cost_divergence(recorded_costs,
                                            replayed_costs),
+        # fingerprint twin: recorded vs replayed output digests
+        # (integrity plane); advisory, bounded like the token diff
+        "digest_divergence":
+            digest_divergences[:MAX_DIVERGENCES_REPORTED],
         # behavioral twin: the flight recorder's event timeline
         # (restarts, sheds, preemptions) compared kind-for-kind
         "event_divergence": event_divergence,

@@ -30,6 +30,12 @@ How the signal flows (zero new protocol):
   next-best host with the failed one excluded, **before** any bytes
   were forwarded, so greedy outputs stay bit-identical and no stream
   ever duplicates tokens;
+- a host the leader's integrity divergence vote **quarantined**
+  (serving/integrity.py) reports QUARANTINED in the routing view, so
+  ``_members`` drops it exactly like a DOWN host: its routed share
+  goes to zero on the next request, session affinity to it is swept
+  (quarantine listener), and requests that would have landed there
+  ride the normal typed-retry failover ladder to a healthy sibling;
 - responses stream through unbuffered: the proxy forwards upstream
   chunks as they arrive (SSE passthrough rides the server's chunked
   writer), it never accumulates a stream in memory.
@@ -369,8 +375,12 @@ class FleetRouter:
         self._client_aborts = 0
         self._rr_next = 0
         self._autoscale_tick = -float("inf")
+        #: integrity-quarantine transitions observed (debug_state)
+        self._quarantines: dict[str, int] = {}
         if hasattr(leader, "add_evict_listener"):
             leader.add_evict_listener(self._on_member_gone)
+        if hasattr(leader, "add_quarantine_listener"):
+            leader.add_quarantine_listener(self._on_quarantine)
 
     # ------------------------------------------------------- membership
     def _on_member_gone(self, host_id: str, reason: str) -> None:
@@ -383,6 +393,20 @@ class FleetRouter:
             self.logger.info(
                 "router dropped session affinity for departed host",
                 host=host_id, reason=reason, sessions=dropped)
+
+    def _on_quarantine(self, host_id: str, action: str) -> None:
+        """Leader quarantine listener: sweep session affinity off a
+        quarantined host immediately — multi-turn chats pinned to it
+        must re-plan onto a healthy sibling, not ride the pin back
+        into bad output — and count both transitions for
+        ``debug_state``. Routing itself needs no action: the
+        QUARANTINED status in the routing view already drops the host
+        from ``_members`` on the next plan."""
+        with self._lock:
+            self._quarantines[action] = \
+                self._quarantines.get(action, 0) + 1
+        if action == "quarantine":
+            self._on_member_gone(host_id, "quarantined")
 
     def _members(self) -> list[dict]:
         view = self.leader.routing_view()
@@ -771,6 +795,7 @@ class FleetRouter:
             affinity_hits = self._affinity_hits
             retries = self._retries
             aborts = self._client_aborts
+            quarantines = dict(self._quarantines)
         out = {
             "policy": self.config.policy,
             "routed": routed,
@@ -780,6 +805,7 @@ class FleetRouter:
                          "hits": affinity_hits},
             "retries": retries,
             "client_aborts": aborts,
+            "quarantines": quarantines,
         }
         if self.autoscaler is not None:
             out["autoscale"] = self.autoscaler.state()

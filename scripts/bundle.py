@@ -34,6 +34,7 @@ SURFACES = (
     ("incidents", "/debug/incidents", True),
     ("engine", "/debug/engine", True),
     ("efficiency", "/debug/efficiency", True),
+    ("integrity", "/debug/integrity", True),
     ("slo", "/debug/slo", True),
     ("scheduler", "/debug/scheduler", True),
     ("workload", "/debug/workload", False),

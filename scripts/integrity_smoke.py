@@ -1,0 +1,230 @@
+"""CI smoke: the output-integrity observatory catches a silently
+corrupting host and the fleet routes around it.
+
+Seals a golden canary set from a captured greedy workload, then boots
+a LEADER App with the data-plane router and THREE workers serving
+identical tiny engines with golden probes armed. One worker carries a
+``logit_corrupt`` fault plan scoped to the probe tenant — the
+deterministic stand-in for bad HBM / a miscompiled kernel: client
+traffic stays clean, but every canary it serves emits a perturbed
+token, so its probe digests diverge while its SLO stays green. Proves
+the full detection -> vote -> quarantine story:
+
+1. the corrupt host's golden-probe digests depart the sealed
+   expectations (a local mismatch episode opens ONCE);
+2. the leader's majority vote names exactly that host as the outlier —
+   one ``fleet.integrity_divergence`` event, one incident bundle — and
+   quarantines it out of the routing view;
+3. post-quarantine traffic routes only to the healthy pair
+   (routed share -> 0 for the outlier) and greedy outputs stay
+   bit-identical to their pre-fault references;
+4. probe device time is priced as ``integrity_probe`` waste and the
+   goodput conservation identity stays exact on every host.
+
+Exits nonzero on any failure; one line per check on success.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
+
+from gofr_tpu.serving.engine import EngineConfig, SamplingParams
+from gofr_tpu.serving.glue import demo_llama_engine
+from gofr_tpu.serving.integrity import GoldenSet
+from gofr_tpu.serving.router import RouterConfig
+from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+from router_smoke import AppThread, chat, make_app, request
+
+WORKERS = ("integrity-w0", "integrity-w1", "integrity-bad")
+BAD = "integrity-bad"
+ENGINE_CFG = dict(max_batch=2, max_seq=128, seed=17,
+                  prefill_buckets=(8,))
+PROBE_PASSES = 6
+
+
+def drain(reqs, timeout=120):
+    deadline = time.time() + timeout
+    while time.time() < deadline and any(
+            r.finished_at is None and r.error is None for r in reqs):
+        time.sleep(0.01)
+    return reqs
+
+
+def seal_golden(path: str) -> None:
+    """The operator flow: capture a greedy workload, seal canaries."""
+    engine = demo_llama_engine(EngineConfig(
+        workload_capture=True, **ENGINE_CFG))
+    engine.start()
+    reqs = [engine.submit([5 + i, 2, 9], SamplingParams(
+        temperature=0.0, max_new_tokens=6)) for i in range(3)]
+    drain(reqs)
+    records = engine.workload.snapshot()["records"]
+    engine.stop()
+    assert all(r.error is None for r in reqs), \
+        [r.error for r in reqs]
+    golden = GoldenSet.seal(records)
+    assert len(golden) == 3, len(golden)
+    golden.save(path)
+
+
+def main() -> int:
+    golden_path = os.path.join(tempfile.mkdtemp(prefix="gofr-golden-"),
+                               "golden.jsonl")
+    seal_golden(golden_path)
+    print(f"ok: sealed 3 golden canaries from a captured workload")
+
+    leader_app = make_app("integrity-leader")
+    leader = leader_app.serve_fleet_leader(
+        host_id="leader", router=RouterConfig(max_retries=2,
+                                              policy="round_robin"))
+    router = leader.router
+    leader_thread = AppThread(leader_app).start()
+    leader_url = f"http://127.0.0.1:{leader_thread.port}"
+    lport = leader_thread.port
+
+    workers, engines = [], {}
+    for host in WORKERS:
+        cfg = dict(ENGINE_CFG, integrity_golden_path=golden_path,
+                   integrity_probe_passes=PROBE_PASSES)
+        if host == BAD:
+            # scoped to the probe tenant: client bytes stay clean, the
+            # canaries corrupt — silent corruption the SLO cannot see
+            cfg["faults"] = "logit_corrupt:times=0,request=_integrity"
+        app = make_app(host)
+        engine = demo_llama_engine(EngineConfig(**cfg))
+        app.serve_model("llm", engine, ByteTokenizer())
+        app.join_fleet(leader_url, host_id=host,
+                       heartbeat_interval_s=0.2)
+        workers.append((host, AppThread(app).start()))
+        engines[host] = engine
+
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            view = leader.routing_view()
+            if len(view) == 3 and all(m["address"] for m in view):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("workers never became routable")
+        print("ok: three workers advertised routable addresses")
+
+        # greedy references before any probe has a chance to mismatch
+        prompts = [f"integrity check {i}" for i in range(4)]
+        refs = {}
+        for p in prompts:
+            status, _, data = chat(lport, p, max_tokens=8)
+            assert status == 201, (status, data[:200])
+            refs[p] = json.loads(data)["data"]["tokens"]
+            assert refs[p], p
+
+        # keep passes flowing until every host has served probes and
+        # the leader's vote quarantines the corrupt one
+        deadline = time.time() + 120
+        quarantined = None
+        i = 0
+        while time.time() < deadline and quarantined is None:
+            status, _, data = chat(lport, f"tick {i}", max_tokens=4)
+            assert status == 201, (status, data[:200])
+            i += 1
+            q = leader.fleet_status()["integrity"]["quarantined"]
+            if q:
+                quarantined = dict(q)
+            time.sleep(0.05)
+        assert quarantined is not None, "no host was ever quarantined"
+        assert sorted(quarantined) == [BAD], quarantined
+        assert quarantined[BAD]["majority"] is not None
+        print(f"ok: the vote quarantined {BAD} on golden probe "
+              f"{quarantined[BAD]['golden_id']}")
+
+        # exactly one divergence event naming the outlier, exactly one
+        # incident bundle — however many heartbeats repeated the bad
+        # digest before the vote landed
+        divergences = leader.events.snapshot(
+            kind="fleet.integrity_divergence")
+        assert len(divergences) == 1, divergences
+        assert divergences[0]["attrs"]["outlier"] == BAD
+        bundles = [b for b in leader.incidents.list()
+                   if b["reason"] == "integrity_divergence"]
+        assert len(bundles) == 1, bundles
+        print("ok: exactly one fleet.integrity_divergence event and "
+              "one incident bundle")
+
+        # the corrupt host saw its own local mismatch episode too —
+        # opened ONCE despite every probe mismatching since
+        bad_state = engines[BAD].integrity_state()
+        assert bad_state["probes"]["mismatch"] >= 1, bad_state
+        assert bad_state["episodes"] == 1, bad_state
+        assert engines[BAD].stats["integrity_failures"] == 1
+        healthy = [h for h in WORKERS if h != BAD]
+        for h in healthy:
+            state = engines[h].integrity_state()
+            assert state["probes"]["mismatch"] == 0, (h, state)
+        print("ok: local mismatch episode opened once on the corrupt "
+              "host, zero on the healthy pair")
+
+        # routed share -> 0: post-quarantine traffic lands only on the
+        # healthy pair, bit-identical to the pre-fault references
+        statuses = {m["host_id"]: m["status"]
+                    for m in leader.routing_view()}
+        assert statuses[BAD] == "QUARANTINED", statuses
+        before = dict(router.debug_state()["routed"])
+        for p in prompts:
+            status, _, data = chat(lport, p, max_tokens=8)
+            assert status == 201, (status, data[:200])
+            got = json.loads(data)["data"]["tokens"]
+            assert got == refs[p], (p, got, refs[p])
+        routed = router.debug_state()["routed"]
+        assert routed.get(BAD, 0) == before.get(BAD, 0), \
+            (before, routed)
+        assert sum(routed.get(h, 0) - before.get(h, 0)
+                   for h in healthy) == len(prompts)
+        print("ok: 4/4 post-quarantine outputs bit-identical, routed "
+              f"share of {BAD} pinned at zero")
+
+        # canary pricing: probe device time is integrity_probe waste
+        # and the conservation identity stays exact on every host
+        for h in WORKERS:
+            goodput = engines[h].goodput.state()
+            assert goodput["conservation_error_s"] == 0.0, (h, goodput)
+            assert goodput["waste_s"].get("integrity_probe", 0) > 0, \
+                (h, goodput["waste_s"])
+        print("ok: integrity_probe waste priced on all hosts, "
+              "conservation_error_s == 0.0")
+
+        # the debug + metrics surfaces ship the story
+        wport = dict(workers)[BAD].port
+        status, _, data = request(wport, "GET", "/debug/integrity")
+        assert status == 200, status
+        integ = json.loads(data)["data"]["llm"]
+        assert integ["episode"] and integ["golden"]["count"] == 3, integ
+        status, _, data = request(dict(workers)[BAD].metrics_port,
+                                  "GET", "/metrics")
+        assert status == 200 and \
+            b"app_engine_integrity_failures" in data
+        status, _, data = request(leader_thread.metrics_port, "GET",
+                                  "/metrics")
+        assert status == 200, status
+        text = data.decode()
+        assert "app_fleet_quarantined_hosts" in text
+        assert "app_fleet_quarantines" in text
+        print("ok: /debug/integrity + quarantine metrics surfaces")
+    except AssertionError as exc:
+        print(f"FAIL: {exc}", file=sys.stderr)
+        return 1
+    finally:
+        for _host, thread in workers:
+            thread.stop()
+        leader_thread.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

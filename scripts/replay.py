@@ -98,6 +98,16 @@ def main() -> int:
               f"{div['recorded_mean_s'] * 1e3:.3f}ms -> "
               f"{div['replayed_mean_s'] * 1e3:.3f}ms "
               f"(x{div['ratio']})", file=sys.stderr)
+    for div in report.get("digest_divergence") or []:
+        # advisory like the others — NEVER an exit condition: a digest
+        # mismatch with matching tokens means the fingerprint inputs
+        # drifted (params quantization, digest version), which would
+        # make golden probes sealed from this capture misfire
+        match = "tokens matched" if div.get("tokens_match") \
+            else "tokens also diverged"
+        print(f"# DIGEST DIVERGED: request {div['index']} "
+              f"{div['recorded'][:12]}... -> {div['replayed'][:12]}... "
+              f"({match})", file=sys.stderr)
     ev_div = report.get("event_divergence")
     if ev_div and ev_div.get("diverged"):
         # advisory like the efficiency diff: replay timing legitimately

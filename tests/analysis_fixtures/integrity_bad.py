@@ -1,0 +1,24 @@
+"""hot-path-purity: output fingerprinting inlined in the decode
+collect — the anti-pattern serving/integrity.py exists to prevent.
+Lines matter — test_analysis.py pins them."""
+import numpy as np
+
+from gofr_tpu.analysis import hot_path
+
+
+class Engine:
+    @hot_path
+    def collect(self, step, reqs):
+        # ad-hoc fingerprinting: a device download plus telemetry
+        # writes inline in the collect path, once per PASS
+        toks = np.asarray(step.tokens)                           # L14
+        for req in reqs:
+            req.fold.update(bytes(toks[req.row]))
+            if req.fold.hexdigest() != req.expected:
+                self.metrics.increment_counter("app_integrity")  # L18
+                self.logger.warn("digest diverged", req=req.id)  # L19
+        return self._stamp(reqs)
+
+    def _stamp(self, reqs):
+        # undecorated helper on the closure: its download flags too
+        return [bytes(np.asarray(r.state)) for r in reqs]        # L24

@@ -1,0 +1,40 @@
+"""hot-path-purity: the clean twin — an IntegrityPlane whose ``fold``
+is a @hot_path_boundary (the serving/integrity.py pattern): the digest
+runs over token ids the collect already emitted as host ints, and the
+mismatch counter/WARN live inside the boundary. None of this may be
+flagged."""
+
+from gofr_tpu.analysis import hot_path, hot_path_boundary
+
+
+class IntegrityPlane:
+    @hot_path_boundary("digest fold at the retire boundary: one "
+                       "blake2b over already-emitted host token ids "
+                       "plus probe bookkeeping — once per request, "
+                       "never per pass; the purity walk stops here")
+    def fold(self, req):
+        # inside the boundary anything goes — this models
+        # serving/integrity.py IntegrityPlane.fold
+        digest = self.fingerprint(req.prompt_tokens, req.generated)
+        req.digest = digest
+        if req.probe and digest != req.probe_expected:
+            self.metrics.increment_counter(
+                "app_engine_integrity_failures", kind="probe_mismatch")
+            self.logger.warn("golden probe digest mismatch",
+                             golden=req.probe)
+        return digest
+
+
+DISABLED = IntegrityPlane()
+
+
+class Engine:
+    @hot_path
+    def retire(self, req):
+        # the fold: one boundary call at retire, nothing inline
+        if self.integrity is not DISABLED:
+            self.integrity.fold(req)
+        return self._finish(req)
+
+    def _finish(self, req):
+        return req

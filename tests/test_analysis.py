@@ -200,6 +200,48 @@ class TestCostModelContract:
         assert not offenders, offenders
 
 
+# -------------------------------------------------- integrity contract
+class TestIntegrityContract:
+    """The serving/integrity.py contract, lint-enforced: output
+    fingerprinting is legal ONLY through @hot_path_boundary folds
+    (``IntegrityPlane.fold`` / ``Engine._note_integrity``) — inline
+    digest downloads, mismatch counters or WARNs in a hot root (or a
+    closure-reached helper) must flag."""
+
+    def test_inline_fingerprinting_flags(self):
+        got = violations(lint("integrity_bad.py"), "hot-path-purity")
+        lines = {f.line for f in got}
+        assert {14, 18, 19} <= lines          # download + telemetry
+        assert 24 in lines                    # closure-reached helper
+
+    def test_boundary_fold_is_clean(self):
+        assert violations(lint("integrity_good.py"),
+                          "hot-path-purity") == []
+
+    def test_live_folds_declare_boundaries(self):
+        # the real modules, not fixtures: both the plane's fold and
+        # the engine's per-request feed must keep their boundaries
+        # (with reasons) or every retire site would drag the digest,
+        # probe pricing and mismatch telemetry into the hot closure
+        from gofr_tpu.serving.engine import Engine
+        from gofr_tpu.serving.integrity import IntegrityPlane
+        for entry in (IntegrityPlane.fold, Engine._note_integrity):
+            reason = getattr(entry, "__gofr_hot_path_boundary__", "")
+            assert isinstance(reason, str) and reason.strip(), entry
+
+    def test_live_repo_hot_closure_excludes_integrity(self):
+        # with the plane ON by default, the engine's hot closure must
+        # not grow into integrity.py: folding is only reachable
+        # through already-declared boundary sites
+        from gofr_tpu.analysis.callgraph import CallGraph
+        from gofr_tpu.analysis.core import load_project
+        project = load_project([REPO / "gofr_tpu" / "serving"], root=REPO)
+        closure = CallGraph(project).hot_closure()
+        offenders = [str(k) for k in closure
+                     if k.module.endswith("integrity.py")]
+        assert not offenders, offenders
+
+
 # ------------------------------------------------ speculation contract
 class TestSpeculationContract:
     """The drafting/controller contract, lint-enforced: n-gram index

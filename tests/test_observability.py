@@ -365,7 +365,8 @@ def test_e2e_debug_efficiency_conserves(obs_app):
     assert abs(gp["conservation_error_s"]) < 1e-9, gp
     assert 0.0 < gp["goodput_ratio"] <= 1.0
     assert set(gp["waste_s"]) == {"padding", "preempt_recompute",
-                                 "spec_rejected", "bubble"}
+                                 "spec_rejected", "bubble",
+                                 "integrity_probe"}
     assert eff["watermarks"]["kv_pages"]["value"] > 0
     assert "t" in eff["watermarks"]["kv_pages"]
     assert "recompiles" in eff["recompiles"]

@@ -609,6 +609,62 @@ def test_bf16_tree_pools_within_cast_bound():
             np.asarray(want, np.float32)[i, :n], atol=2e-2)
 
 
+# ------------------------------------- len-0 slot kernel/XLA parity
+#
+# The Pallas kernels return exact zeros for zero-length slots (denom
+# clamp + masked DMA); the _xla fallbacks used to let the dense
+# softmax degrade to an unmasked average over garbage rows there,
+# leaving the engine's discard of inactive-slot tokens load-bearing
+# for correctness. All three fallbacks now zero len-0 rows, so which
+# path served a pass can never leak into output bytes — the integrity
+# plane's digest parity (serving/integrity.py) rides this. These pin
+# exact (atol=0) zeros on BOTH paths for every kernel family.
+
+def test_len0_slot_zeroed_on_both_paths_decode():
+    case = _random_paged_case(jax.random.key(91), lengths=(0, 8, 16))
+    q, k_pool, v_pool, tables, lengths, *_ = case
+    kernel = paged_decode_attention_pallas(q, k_pool, v_pool, tables,
+                                           lengths, interpret=True)
+    fallback = paged_decode_attention_xla(q, k_pool, v_pool, tables,
+                                          lengths)
+    assert not np.isnan(np.asarray(fallback)).any()
+    np.testing.assert_array_equal(np.asarray(kernel)[0],
+                                  np.zeros_like(np.asarray(kernel)[0]))
+    np.testing.assert_array_equal(np.asarray(fallback)[0],
+                                  np.zeros_like(np.asarray(fallback)[0]))
+
+
+def test_len0_slot_zeroed_on_both_paths_chunk():
+    (q, k_pool, v_pool, _, _, tables, history,
+     chunk_lens) = _quant_chunk_case(97, page=8, hq=4, hkv=4)
+    kernel = paged_chunk_attention_pallas(q, k_pool, v_pool, tables,
+                                          history, chunk_lens,
+                                          interpret=True)
+    fallback = paged_chunk_attention_xla(q, k_pool, v_pool, tables,
+                                         history, chunk_lens)
+    assert not np.isnan(np.asarray(fallback)).any()
+    # slot 2 has history == chunk == 0: every row is dead padding
+    np.testing.assert_array_equal(np.asarray(kernel)[2],
+                                  np.zeros_like(np.asarray(kernel)[2]))
+    np.testing.assert_array_equal(np.asarray(fallback)[2],
+                                  np.zeros_like(np.asarray(fallback)[2]))
+
+
+def test_len0_slot_zeroed_on_both_paths_tree():
+    (q, k_pool, v_pool, tables, history, chunk_lens,
+     masks) = _tree_case(101, branches=2, hq=4, hkv=4)
+    kernel = paged_tree_attention_pallas(q, k_pool, v_pool, tables,
+                                         history, chunk_lens, masks,
+                                         interpret=True)
+    fallback = paged_tree_attention_xla(q, k_pool, v_pool, tables,
+                                        history, chunk_lens, masks)
+    assert not np.isnan(np.asarray(fallback)).any()
+    np.testing.assert_array_equal(np.asarray(kernel)[2],
+                                  np.zeros_like(np.asarray(kernel)[2]))
+    np.testing.assert_array_equal(np.asarray(fallback)[2],
+                                  np.zeros_like(np.asarray(fallback)[2]))
+
+
 def test_tree_dispatch_auto_on_cpu_matches_dense():
     (q, k_pool, v_pool, tables, history, chunk_lens,
      masks) = _tree_case(83, branches=2, hq=8, hkv=2)
